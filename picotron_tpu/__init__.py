@@ -1,0 +1,19 @@
+"""picotron-tpu: a TPU-native 4D-parallel LLM pre-training framework.
+
+A from-scratch JAX/XLA/Pallas framework with the capabilities of the
+reference `picotron` (HuggingFace's minimalist 4D-parallel trainer), designed
+SPMD/compiler-first for TPU:
+
+- one `jax.sharding.Mesh` with axes ``('dp', 'pp', 'cp', 'tp')`` replaces the
+  per-rank process-group singleton (ref: picotron/process_group_manager.py),
+- data / tensor / pipeline / context parallelism are composed inside a single
+  `shard_map`-ped train step with explicit XLA collectives
+  (`psum` / `all_gather` / `ppermute`) riding ICI,
+- the hot attention path is a Pallas flash-attention kernel that exports
+  per-block LSE so the context-parallel ring can reuse it.
+"""
+
+__version__ = "0.1.0"
+
+from picotron_tpu.config import Config, load_config  # noqa: F401
+from picotron_tpu.mesh import MeshEnv  # noqa: F401

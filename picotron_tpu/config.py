@@ -1,0 +1,359 @@
+"""Typed configuration for picotron-tpu.
+
+One explicit config object threaded through the whole program — this replaces
+both of the reference's config channels: the JSON file
+(ref: template/base_config.json:1-52) and the shadow environment-variable
+channel (`FLASH_ATTEN` / `CONTEXT_PARALLEL` / `DEVICE` / `DTYPE`, ref:
+train.py:65-77, model.py:127-158, context_parallel.py:10-12), which SURVEY.md
+§5 flags as a design wart.
+
+The JSON schema is compatible with the reference's: a reference config.json
+loads unchanged (unknown keys are ignored; the `environment` section is
+irrelevant on TPU). Model hyperparameters resolve from a built-in preset
+registry instead of a network `AutoConfig` fetch (ref: create_config.py:51-55)
+— TPU pods frequently run with zero egress, so presets are first-class and
+explicit overrides always win.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+# ---------------------------------------------------------------------------
+# Model preset registry (replaces network AutoConfig lookup).
+# Hyperparameters are the public ones for each model family.
+# ---------------------------------------------------------------------------
+
+MODEL_PRESETS: dict[str, dict[str, Any]] = {
+    # SmolLM family (Llama architecture)
+    "HuggingFaceTB/SmolLM-135M": dict(
+        vocab_size=49152, hidden_size=576, intermediate_size=1536,
+        num_hidden_layers=30, num_attention_heads=9, num_key_value_heads=3,
+        max_position_embeddings=2048, rope_theta=10000.0, rms_norm_eps=1e-5,
+    ),
+    "HuggingFaceTB/SmolLM-360M": dict(
+        vocab_size=49152, hidden_size=960, intermediate_size=2560,
+        num_hidden_layers=32, num_attention_heads=15, num_key_value_heads=5,
+        max_position_embeddings=2048, rope_theta=10000.0, rms_norm_eps=1e-5,
+    ),
+    "HuggingFaceTB/SmolLM-1.7B": dict(
+        vocab_size=49152, hidden_size=2048, intermediate_size=8192,
+        num_hidden_layers=24, num_attention_heads=32, num_key_value_heads=32,
+        max_position_embeddings=2048, rope_theta=10000.0, rms_norm_eps=1e-5,
+    ),
+    # Llama-2
+    "meta-llama/Llama-2-7b-hf": dict(
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=32,
+        max_position_embeddings=4096, rope_theta=10000.0, rms_norm_eps=1e-5,
+    ),
+    "meta-llama/Llama-2-13b-hf": dict(
+        vocab_size=32000, hidden_size=5120, intermediate_size=13824,
+        num_hidden_layers=40, num_attention_heads=40, num_key_value_heads=40,
+        max_position_embeddings=4096, rope_theta=10000.0, rms_norm_eps=1e-5,
+    ),
+    # Llama-3
+    "meta-llama/Meta-Llama-3-8B": dict(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+        max_position_embeddings=8192, rope_theta=500000.0, rms_norm_eps=1e-5,
+    ),
+    # TinyLlama
+    "TinyLlama/TinyLlama-1.1B-Chat-v1.0": dict(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=22, num_attention_heads=32, num_key_value_heads=4,
+        max_position_embeddings=2048, rope_theta=10000.0, rms_norm_eps=1e-5,
+    ),
+    # Tiny debug model for tests / CI
+    "picotron-tpu/debug-tiny": dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0, rms_norm_eps=1e-5,
+    ),
+}
+
+# Aliases so shorthand names in configs resolve too.
+_PRESET_ALIASES = {
+    "SmolLM-135M": "HuggingFaceTB/SmolLM-135M",
+    "SmolLM-360M": "HuggingFaceTB/SmolLM-360M",
+    "HuggingFaceTB/SmolLM-360M-Instruct": "HuggingFaceTB/SmolLM-360M",
+    "SmolLM-1.7B": "HuggingFaceTB/SmolLM-1.7B",
+    "HuggingFaceTB/SmolLM-1.7B-Instruct": "HuggingFaceTB/SmolLM-1.7B",
+    "Llama-2-7B": "meta-llama/Llama-2-7b-hf",
+    "Llama-2-13B": "meta-llama/Llama-2-13b-hf",
+    "Llama-3-8B": "meta-llama/Meta-Llama-3-8B",
+    "TinyLlama-1.1B": "TinyLlama/TinyLlama-1.1B-Chat-v1.0",
+    "debug-tiny": "picotron-tpu/debug-tiny",
+}
+
+
+def resolve_preset(name: str) -> dict[str, Any]:
+    key = _PRESET_ALIASES.get(name, name)
+    if key in MODEL_PRESETS:
+        return dict(MODEL_PRESETS[key])
+    raise KeyError(
+        f"Unknown model preset {name!r}. Known presets: "
+        f"{sorted(MODEL_PRESETS) + sorted(_PRESET_ALIASES)}. "
+        "Pass explicit hyperparameters in the `model` config section instead."
+    )
+
+
+# ---------------------------------------------------------------------------
+# Config sections — mirror the reference JSON sections one-to-one.
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DistributedConfig:
+    """4D parallel layout (ref: template/base_config.json:2-10)."""
+
+    tp_size: int = 1
+    cp_size: int = 1
+    pp_size: int = 1
+    dp_size: int = 1
+    pp_engine: str = "1f1b"  # "1f1b" | "afab"
+    # Accepted for reference-JSON compatibility; ignored (XLA picks transport).
+    backend: str = "jax"
+    use_cpu: bool = False
+
+    @property
+    def world_size(self) -> int:
+        return self.tp_size * self.cp_size * self.pp_size * self.dp_size
+
+    def validate(self) -> None:
+        for name in ("tp_size", "cp_size", "pp_size", "dp_size"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"{name} must be >= 1, got {getattr(self, name)}")
+        if self.pp_engine not in ("1f1b", "afab"):
+            raise ValueError(f"pp_engine must be '1f1b' or 'afab', got {self.pp_engine!r}")
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Llama-family architecture hyperparameters.
+
+    Resolved from a preset by name with explicit overrides on top
+    (ref: create_config.py:51-63 does the same via AutoConfig + overrides).
+    """
+
+    name: str = "picotron-tpu/debug-tiny"
+    vocab_size: int = 256
+    hidden_size: int = 64
+    intermediate_size: int = 128
+    num_hidden_layers: int = 4
+    num_attention_heads: int = 4
+    num_key_value_heads: int = 2
+    max_position_embeddings: int = 256
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    dtype: str = "bfloat16"  # compute/activation dtype; master params are fp32
+    # Attention implementation: "auto" picks flash on TPU / reference on CPU;
+    # CP > 1 always routes through the ring (ref: model.py:148-158 dispatch).
+    attn_impl: str = "auto"  # "auto" | "flash" | "reference" | "ring"
+    # Accepted for reference compat (ref uses them to pick CUDA kernels).
+    use_flash_attention: bool = True
+    use_fused_adam: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    def validate(self) -> None:
+        if self.hidden_size % self.num_attention_heads != 0:
+            raise ValueError("hidden_size must be divisible by num_attention_heads")
+        if self.num_attention_heads % self.num_key_value_heads != 0:
+            raise ValueError("num_attention_heads must be divisible by num_key_value_heads")
+        if self.head_dim % 2 != 0:
+            raise ValueError("head_dim must be even for RoPE")
+
+
+@dataclass(frozen=True)
+class TrainingConfig:
+    """(ref: template/base_config.json:20-29)."""
+
+    seed: int = 42
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.0
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_eps: float = 1e-8
+    grad_clip_norm: float = 0.0  # 0 disables clipping
+    total_train_steps: int = 200
+    seq_length: int = 1024
+    micro_batch_size: int = 1
+    gradient_accumulation_steps: int = 1
+    num_samples: Optional[int] = None
+    max_tokens: Optional[int] = None
+    # Gradient rematerialization for long-context / big-model memory savings.
+    remat: bool = True
+
+
+@dataclass(frozen=True)
+class DatasetConfig:
+    """(ref: template/base_config.json:30-35). `synthetic` replaces network
+    datasets in tests/benchmarks (deterministic PRNG token stream)."""
+
+    name: str = "synthetic"
+    subset_name: Optional[str] = None
+    tokenizer_name: Optional[str] = None
+    num_workers: int = 0
+    num_proc: int = 1
+    split: str = "train"
+    text_column: str = "text"
+
+
+@dataclass(frozen=True)
+class CheckpointConfig:
+    """(ref: template/base_config.json:36-40)."""
+
+    save_dir: str = "ckpt"
+    save_frequency: int = 0  # 0 disables periodic saving
+    load_path: str = ""
+
+
+@dataclass(frozen=True)
+class LoggingConfig:
+    """(ref: template/base_config.json:41-45)."""
+
+    use_wandb: bool = False
+    project_name: str = "picotron-tpu"
+    run_name: Optional[str] = None
+    log_frequency: int = 1
+
+
+@dataclass(frozen=True)
+class Config:
+    distributed: DistributedConfig = field(default_factory=DistributedConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    training: TrainingConfig = field(default_factory=TrainingConfig)
+    dataset: DatasetConfig = field(default_factory=DatasetConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    logging: LoggingConfig = field(default_factory=LoggingConfig)
+
+    # -- derived quantities (ref: data.py:17-20) --
+
+    @property
+    def global_batch_size(self) -> int:
+        t = self.training
+        return t.micro_batch_size * t.gradient_accumulation_steps * self.distributed.dp_size
+
+    @property
+    def tokens_per_step(self) -> int:
+        return self.global_batch_size * self.training.seq_length
+
+    @property
+    def seq_length_per_device(self) -> int:
+        return self.training.seq_length // self.distributed.cp_size
+
+    def validate(self) -> None:
+        self.distributed.validate()
+        self.model.validate()
+        d, m, t = self.distributed, self.model, self.training
+        if m.num_attention_heads % d.tp_size != 0:
+            raise ValueError("num_attention_heads must be divisible by tp_size")
+        if m.num_key_value_heads % d.tp_size != 0:
+            raise ValueError("num_key_value_heads must be divisible by tp_size")
+        if m.vocab_size % d.tp_size != 0:
+            raise ValueError("vocab_size must be divisible by tp_size")
+        if t.seq_length < 1:
+            raise ValueError(f"seq_length must be >= 1, got {t.seq_length}")
+        if t.seq_length % d.cp_size != 0:
+            raise ValueError("seq_length must be divisible by cp_size")
+        if d.pp_size > m.num_hidden_layers:
+            raise ValueError(
+                f"pp_size ({d.pp_size}) cannot exceed num_hidden_layers ({m.num_hidden_layers})"
+            )
+
+    def to_json_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def replace(self, **sections: Any) -> "Config":
+        return dataclasses.replace(self, **sections)
+
+
+# ---------------------------------------------------------------------------
+# Loading
+# ---------------------------------------------------------------------------
+
+
+def _filter_kwargs(cls: type, raw: dict[str, Any]) -> dict[str, Any]:
+    names = {f.name for f in dataclasses.fields(cls)}
+    return {k: v for k, v in raw.items() if k in names}
+
+
+def config_from_dict(raw: dict[str, Any]) -> Config:
+    """Build a Config from a (reference-schema-compatible) dict."""
+    model_raw = dict(raw.get("model", {}))
+    name = model_raw.get("name")
+    if name:
+        try:
+            preset = resolve_preset(name)
+        except KeyError:
+            # Unknown name is only acceptable when the JSON itself carries the
+            # architecture — otherwise a typo'd name would silently train the
+            # tiny debug defaults.
+            core = {"vocab_size", "hidden_size", "intermediate_size", "num_hidden_layers"}
+            if not core.issubset(model_raw):
+                raise
+            preset = {}
+        # Explicit values in the JSON override the preset (ref:
+        # create_config.py:56-63 same precedence for layer/head overrides).
+        merged = {**preset, **{k: v for k, v in model_raw.items() if v is not None}}
+    else:
+        # No name: a partially-specified architecture would silently merge
+        # with the tiny debug defaults — require the core fields, or nothing.
+        core = {"vocab_size", "hidden_size", "intermediate_size", "num_hidden_layers"}
+        arch_keys = {k for k, v in model_raw.items() if v is not None} - {
+            "dtype", "attn_impl", "use_flash_attention", "use_fused_adam"
+        }
+        if arch_keys and not core.issubset(arch_keys):
+            raise ValueError(
+                "model section specifies architecture fields without a `name`; "
+                f"either set `name` to a preset or provide all of {sorted(core)}"
+            )
+        merged = model_raw
+    # The reference allows `num_hidden_layers: null` meaning "use preset".
+    merged = {k: v for k, v in merged.items() if v is not None}
+
+    cfg = Config(
+        distributed=DistributedConfig(**_filter_kwargs(DistributedConfig, raw.get("distributed", {}))),
+        model=ModelConfig(**_filter_kwargs(ModelConfig, merged)),
+        training=TrainingConfig(**_filter_kwargs(TrainingConfig, raw.get("training", {}))),
+        dataset=DatasetConfig(**_filter_kwargs(DatasetConfig, raw.get("dataset", {}))),
+        checkpoint=CheckpointConfig(**_filter_kwargs(CheckpointConfig, raw.get("checkpoint", {}))),
+        logging=LoggingConfig(**_filter_kwargs(LoggingConfig, raw.get("logging", {}))),
+    )
+    cfg.validate()
+    return cfg
+
+
+def load_config(path: str) -> Config:
+    """Load a config JSON (reference schema compatible, ref: train.py:62)."""
+    with open(path) as f:
+        raw = json.load(f)
+    return config_from_dict(raw)
+
+
+def save_config(cfg: Config, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(cfg.to_json_dict(), f, indent=2)
+
+
+def num_params(m: ModelConfig) -> int:
+    """Total parameter count (embedding + untied head counted separately,
+    matching the reference's accounting in utils.py:50-79)."""
+    h, i, v, l = m.hidden_size, m.intermediate_size, m.vocab_size, m.num_hidden_layers
+    kv = m.num_key_value_heads * m.head_dim
+    per_layer = (
+        h * h  # q_proj
+        + h * kv * 2  # k/v_proj
+        + h * h  # out_proj
+        + 3 * h * i  # gate/up/down
+        + 2 * h  # two RMSNorm weights
+    )
+    return v * h + l * per_layer + h + h * v  # embed + layers + final_norm + head

@@ -1,0 +1,150 @@
+"""4D device mesh — the TPU-native replacement for the reference's
+process-group singleton (ref: picotron/process_group_manager.py).
+
+The reference builds a rank grid `arange(world).view(dp, pp, cp, tp)` with TP
+fastest-varying (ref: process_group_manager.py:13) and derives 6 communicator
+subgroups from it. On TPU the grid *is* a `jax.sharding.Mesh` with named axes
+``('dp', 'pp', 'cp', 'tp')``; every communicator the reference creates becomes
+a named-axis collective:
+
+- tp group      -> `lax.psum(..., 'tp')` / `lax.all_gather(..., 'tp')`
+- cp ring       -> `lax.ppermute(..., 'cp', ...)`
+- pp p2p        -> `lax.ppermute(..., 'pp', ...)`
+- cp_dp group   -> `lax.pmean(..., ('cp', 'dp'))` (gradient sync, ref:
+                   data_parallel.py:83)
+- pp_dp group   -> axis tuple ('pp', 'dp')
+
+TP is innermost so it maps to the fastest ICI axis, same ordering rationale as
+the reference's grid. Axis order here is (dp, pp, cp, tp) — identical to the
+reference's view order.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis names, outermost to innermost.
+AXES = ("dp", "pp", "cp", "tp")
+
+
+def force_host_device_count(n: int) -> None:
+    """Request `n` simulated host (CPU) devices. Must run before JAX backends
+    initialize — the test conftest and the multichip dry-run use this
+    (the TPU analogue of the reference's gloo/CPU path, ref: train.py:83).
+
+    Raises if the flag is already pinned to a different count (a silent skip
+    would surface later as a confusing mesh-oversubscription error).
+    """
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m:
+        have = int(m.group(1))
+        if have < n:
+            raise RuntimeError(
+                f"XLA_FLAGS already pins host device count to {have} < requested {n}; "
+                "restart the process with the larger count"
+            )
+        return
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}"
+    ).strip()
+
+
+@dataclass(frozen=True)
+class MeshEnv:
+    """Owns the 4D mesh and the sharding vocabulary built on it."""
+
+    mesh: Mesh
+
+    # -- construction ------------------------------------------------------
+
+    @staticmethod
+    def create(
+        dp: int = 1,
+        pp: int = 1,
+        cp: int = 1,
+        tp: int = 1,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ) -> "MeshEnv":
+        devices = list(devices if devices is not None else jax.devices())
+        world = dp * pp * cp * tp
+        if world > len(devices):
+            raise ValueError(
+                f"dp*pp*cp*tp = {world} exceeds available devices ({len(devices)}). "
+                "(ref parity: train.py:86 asserts world_size == dp*pp*cp*tp)"
+            )
+        grid = np.array(devices[:world]).reshape(dp, pp, cp, tp)
+        return MeshEnv(Mesh(grid, AXES))
+
+    @staticmethod
+    def from_config(cfg) -> "MeshEnv":
+        d = cfg.distributed
+        return MeshEnv.create(dp=d.dp_size, pp=d.pp_size, cp=d.cp_size, tp=d.tp_size)
+
+    # -- axis sizes --------------------------------------------------------
+
+    @property
+    def dp(self) -> int:
+        return self.mesh.shape["dp"]
+
+    @property
+    def pp(self) -> int:
+        return self.mesh.shape["pp"]
+
+    @property
+    def cp(self) -> int:
+        return self.mesh.shape["cp"]
+
+    @property
+    def tp(self) -> int:
+        return self.mesh.shape["tp"]
+
+    @property
+    def world_size(self) -> int:
+        return self.dp * self.pp * self.cp * self.tp
+
+    # -- sharding vocabulary ----------------------------------------------
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharding(self) -> NamedSharding:
+        """Sharding for a [micro, batch, seq] token block: batch over dp,
+        sequence over cp. The contiguous per-cp-rank sequence slice the
+        reference does by hand in its collate fn (ref: data.py:105-109) falls
+        out of sharding the sequence dimension."""
+        return self.sharding(None, "dp", "cp")
+
+
+def multihost_initialize() -> None:
+    """Initialize the JAX distributed runtime for multi-host pods.
+
+    One process per host over ICI/DCN replaces the reference's
+    one-process-per-GPU torchrun + NCCL rendezvous (ref: base_job.slurm:64,
+    train.py:94). `jax.distributed.initialize()` auto-detects Cloud TPU pod
+    metadata, SLURM, and MPI cluster environments; we attempt it whenever any
+    such environment is plausible and fail loudly if detection half-works.
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    cluster_env = (
+        os.environ.get("COORDINATOR_ADDRESS")
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("SLURM_JOB_ID")
+        or os.environ.get("OMPI_COMM_WORLD_SIZE")
+        or os.environ.get("TPU_WORKER_HOSTNAMES", "").count(",") > 0
+    )
+    if cluster_env:
+        jax.distributed.initialize()

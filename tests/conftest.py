@@ -1,0 +1,31 @@
+"""Test scaffold: an 8-device simulated CPU mesh in a single process.
+
+This upgrades the reference's test story (two standalone torchrun scripts
+needing 4 GPUs + NCCL, ref: tests/test_tensor_parallel.py:2) to pytest on a
+host-platform simulated mesh — SURVEY.md §4's recommendation.
+
+Note: the environment's sitecustomize imports jax and registers a TPU backend
+at interpreter startup, so env-var-only platform selection is too late here;
+we force CPU via jax.config before any backend client is created. Only
+bench.py touches the real chip.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 simulated devices, got {len(devs)}"
+    return devs

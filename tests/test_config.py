@@ -1,0 +1,83 @@
+import json
+
+import pytest
+
+from picotron_tpu.config import (
+    Config,
+    config_from_dict,
+    load_config,
+    num_params,
+    resolve_preset,
+)
+
+
+def test_defaults_validate():
+    cfg = Config()
+    cfg.validate()
+    assert cfg.distributed.world_size == 1
+
+
+def test_reference_schema_loads(tmp_path):
+    # A verbatim reference-style config (schema of template/base_config.json)
+    raw = {
+        "distributed": {
+            "tp_size": 2, "cp_size": 1, "pp_size": 2, "dp_size": 2,
+            "pp_engine": "1f1b", "backend": "nccl", "use_cpu": False,
+        },
+        "model": {
+            "name": "HuggingFaceTB/SmolLM-360M-Instruct",
+            "num_hidden_layers": 16,
+            "num_attention_heads": 16,
+            "num_key_value_heads": 4,
+            "dtype": "bfloat16",
+            "use_flash_attention": True,
+            "use_fused_adam": True,
+        },
+        "training": {
+            "seed": 42, "learning_rate": 3e-4, "total_train_steps": 200,
+            "seq_length": 1024, "micro_batch_size": 32,
+            "gradient_accumulation_steps": 1, "num_samples": 400000,
+            "max_tokens": None,
+        },
+        "dataset": {"name": "roneneldan/TinyStories", "subset_name": None,
+                    "num_workers": 0, "num_proc": 1},
+        "checkpoint": {"save_dir": "ckpt", "save_frequency": 300, "load_path": ""},
+        "logging": {"use_wandb": False, "project_name": "picotron", "run_name": None},
+        "environment": {"OMP_NUM_THREADS": "1", "FLASH_ATTEN": "1", "HF_TOKEN": None},
+    }
+    p = tmp_path / "config.json"
+    p.write_text(json.dumps(raw))
+    cfg = load_config(str(p))
+    # overrides beat the preset
+    assert cfg.model.num_hidden_layers == 16
+    assert cfg.model.num_key_value_heads == 4
+    # preset fills the rest
+    assert cfg.model.hidden_size == 960
+    assert cfg.model.vocab_size == 49152
+    assert cfg.global_batch_size == 32 * 1 * 2
+    assert cfg.tokens_per_step == 64 * 1024
+
+
+def test_preset_aliases():
+    assert resolve_preset("SmolLM-1.7B")["hidden_size"] == 2048
+    assert resolve_preset("Llama-2-7B")["num_hidden_layers"] == 32
+    with pytest.raises(KeyError):
+        resolve_preset("nonexistent-model")
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError):
+        config_from_dict({"distributed": {"tp_size": 3},
+                          "model": {"name": "debug-tiny"}})  # 4 heads % 3 != 0
+    with pytest.raises(ValueError):
+        config_from_dict({"distributed": {"cp_size": 3},
+                          "model": {"name": "debug-tiny"},
+                          "training": {"seq_length": 128}})  # 128 % 3 != 0
+
+
+def test_num_params_llama2_7b():
+    from picotron_tpu.config import ModelConfig
+    m = ModelConfig(name="meta-llama/Llama-2-7b-hf", **resolve_preset("Llama-2-7B"))
+    n = num_params(m)
+    # ~6.74B params + untied head
+    assert 6.5e9 < n < 7.1e9

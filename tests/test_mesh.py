@@ -1,0 +1,38 @@
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from picotron_tpu.mesh import AXES, MeshEnv
+
+
+def test_mesh_axes_and_sizes(devices):
+    env = MeshEnv.create(dp=2, pp=2, cp=1, tp=2)
+    assert env.mesh.axis_names == AXES
+    assert (env.dp, env.pp, env.cp, env.tp) == (2, 2, 1, 2)
+    assert env.world_size == 8
+
+
+def test_tp_innermost(devices):
+    # TP must be the fastest-varying axis: adjacent device ids in the same tp
+    # group (ref: process_group_manager.py:13 grid layout).
+    env = MeshEnv.create(dp=2, pp=1, cp=2, tp=2)
+    grid = np.array(env.mesh.devices)
+    ids = np.vectorize(lambda d: d.id)(grid)
+    # along tp, ids are consecutive
+    assert (ids[..., 1] - ids[..., 0] == 1).all()
+
+
+def test_oversubscription_raises(devices):
+    with pytest.raises(ValueError):
+        MeshEnv.create(dp=4, pp=2, cp=2, tp=2)
+
+
+def test_batch_sharding_slices_seq_over_cp(devices):
+    env = MeshEnv.create(dp=2, cp=2, tp=2)
+    x = np.arange(1 * 4 * 8, dtype=np.int32).reshape(1, 4, 8)
+    arr = jax.device_put(x, env.batch_sharding())
+    # each shard holds the full micro dim, batch/dp, seq/cp
+    shard = arr.addressable_shards[0]
+    assert shard.data.shape == (1, 2, 4)
+    np.testing.assert_array_equal(np.asarray(arr), x)
