@@ -73,7 +73,7 @@ MODEL_PRESETS: dict[str, dict[str, Any]] = {
     "picotron-tpu/debug-tiny": dict(
         vocab_size=256, hidden_size=64, intermediate_size=128,
         num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
-        max_position_embeddings=256, rope_theta=10000.0, rms_norm_eps=1e-5,
+        max_position_embeddings=2048, rope_theta=10000.0, rms_norm_eps=1e-5,
     ),
 }
 
@@ -148,7 +148,7 @@ class ModelConfig:
     num_hidden_layers: int = 4
     num_attention_heads: int = 4
     num_key_value_heads: int = 2
-    max_position_embeddings: int = 256
+    max_position_embeddings: int = 2048
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-5
     dtype: str = "bfloat16"  # compute/activation dtype; master params are fp32
@@ -264,6 +264,13 @@ class Config:
             raise ValueError(f"seq_length must be >= 1, got {t.seq_length}")
         if t.seq_length % d.cp_size != 0:
             raise ValueError("seq_length must be divisible by cp_size")
+        if t.seq_length > m.max_position_embeddings:
+            # Same bound the reference applies by construction (ref:
+            # train.py:159 sets seq_length == max_position_embeddings).
+            raise ValueError(
+                f"seq_length ({t.seq_length}) exceeds max_position_embeddings "
+                f"({m.max_position_embeddings})"
+            )
         if d.pp_size > m.num_hidden_layers:
             raise ValueError(
                 f"pp_size ({d.pp_size}) cannot exceed num_hidden_layers ({m.num_hidden_layers})"

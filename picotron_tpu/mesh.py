@@ -137,7 +137,11 @@ def multihost_initialize() -> None:
     metadata, SLURM, and MPI cluster environments; we attempt it whenever any
     such environment is plausible and fail loudly if detection half-works.
     """
-    if jax.process_count() > 1:
+    # Must not touch any backend-initializing jax API before initialize();
+    # consult the distributed global state directly instead.
+    from jax._src import distributed as _jdist
+
+    if _jdist.global_state.client is not None:
         return  # already initialized
     cluster_env = (
         os.environ.get("COORDINATOR_ADDRESS")

@@ -1,0 +1,10 @@
+from picotron_tpu.models.llama import (  # noqa: F401
+    ParallelCtx,
+    init_params,
+    embed,
+    run_layers,
+    final_hidden,
+    logits_from_hidden,
+    forward,
+    loss_fn,
+)

@@ -1,0 +1,268 @@
+"""Llama-family decoder-only model, written as pure functions over a param
+pytree (capability parity with ref: picotron/model.py:227-272).
+
+Architecture: Embedding -> N x (RMSNorm -> GQA-Attention -> residual ->
+RMSNorm -> SwiGLU-MLP -> residual) -> final RMSNorm -> untied LM head
+(ref: model.py:204-209, 265-272).
+
+TPU-first design decisions (vs the reference's nn.Module tree):
+
+- **Stacked layer params.** All decoder layers live in one pytree with a
+  leading layer axis, so the layer loop is a `lax.scan` — one traced layer
+  body, O(1) compile time in depth, and the pipeline-parallel stage slice is
+  literally `tree_map(lambda x: x[stage_lo:stage_hi], layers)`.
+- **Parallelism is injected, not hard-coded.** The model never reads env vars
+  (the reference dispatches attention through `CONTEXT_PARALLEL`/`FLASH_ATTEN`
+  env flags, ref: model.py:148-158). Instead a `ParallelCtx` carries the
+  attention implementation and the TP/CP collective hooks; the single-device
+  defaults are identities, and shard_map-level code swaps in psum/ppermute
+  versions. Head counts are derived from the *local* weight shapes, so the
+  same forward runs unsharded or TP-sharded unchanged.
+- **fp32 master params, bf16 compute.** Params are stored fp32 and cast to
+  the compute dtype at use; autodiff then naturally yields fp32 gradients
+  (the reference gets this with a separate fp32 `main_grad` buffer system,
+  ref: data_parallel.py:66-144).
+- **Init matches the reference exactly** (ref: model.py:110-120, 173-182,
+  221-222, 48-49): linear weights ~ U(±sqrt(1/fan_in)), embedding ~ N(0,1),
+  norm weights = 1, untied head.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from picotron_tpu.config import ModelConfig
+from picotron_tpu.ops.attention import sdpa_attention
+from picotron_tpu.ops.losses import cross_entropy
+from picotron_tpu.ops.rmsnorm import rms_norm
+from picotron_tpu.ops.rope import apply_rope, rope_tables
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Parallel context — how parallelism plugs into the model
+# ---------------------------------------------------------------------------
+
+
+def _identity(x):
+    return x
+
+
+def _default_attn(q, k, v, positions):
+    return sdpa_attention(q, k, v, causal=True,
+                          q_positions=positions, kv_positions=positions)
+
+
+@dataclass(frozen=True)
+class ParallelCtx:
+    """Hooks that parallel wrappers override; defaults are single-device.
+
+    f / g are Megatron's column-parallel entry / row-parallel exit collectives
+    (ref: tp_communications.py:19-49): `f` = identity fwd / psum bwd, applied
+    to activations entering column-parallel matmuls; `g` = psum fwd / identity
+    bwd, applied to row-parallel matmul outputs.
+    """
+
+    # attention impl: (q, k, v, positions) -> out, all [B, S, H_local, D]
+    attn: Callable = _default_attn
+    # TP collectives
+    f: Callable = _identity
+    g: Callable = _identity
+    # embedding lookup (vocab-parallel TP overrides this)
+    embed_lookup: Optional[Callable] = None
+    # fused head+CE (vocab-parallel TP overrides to avoid full-logit gather)
+    head_ce: Optional[Callable] = None
+    # logits gather for eval under TP
+    gather_logits: Callable = _identity
+    # global positions of this shard's tokens [S_local] (context parallelism;
+    # None = 0..S-1)
+    positions: Optional[jnp.ndarray] = None
+    # gradient checkpointing over decoder layers
+    remat: bool = False
+
+
+DEFAULT_CTX = ParallelCtx()
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+
+def _uniform_fan_in(key, fan_in: int, shape) -> jnp.ndarray:
+    bound = (1.0 / fan_in) ** 0.5
+    return jax.random.uniform(key, shape, jnp.float32, -bound, bound)
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    """Full (unsharded) parameter pytree, fp32.
+
+    Layer weights are stacked on a leading layer axis. Matmul weights are
+    stored [in_features, out_features] (x @ w convention).
+    """
+    h = cfg.hidden_size
+    i = cfg.intermediate_size
+    v = cfg.vocab_size
+    nl = cfg.num_hidden_layers
+    d = cfg.head_dim
+    q_out = cfg.num_attention_heads * d
+    kv_out = cfg.num_key_value_heads * d
+
+    keys = jax.random.split(key, 10)
+
+    def stacked(k, fan_in, shape):
+        ks = jax.random.split(k, nl)
+        return jnp.stack([_uniform_fan_in(ks[j], fan_in, shape) for j in range(nl)])
+
+    return {
+        "embedding": jax.random.normal(keys[0], (v, h), jnp.float32),
+        "layers": {
+            "input_norm": jnp.ones((nl, h), jnp.float32),
+            "q": stacked(keys[1], h, (h, q_out)),
+            "k": stacked(keys[2], h, (h, kv_out)),
+            "v": stacked(keys[3], h, (h, kv_out)),
+            "o": stacked(keys[4], q_out, (q_out, h)),
+            "post_norm": jnp.ones((nl, h), jnp.float32),
+            "gate": stacked(keys[5], h, (h, i)),
+            "up": stacked(keys[6], h, (h, i)),
+            "down": stacked(keys[7], i, (i, h)),
+        },
+        "final_norm": jnp.ones((h,), jnp.float32),
+        "lm_head": _uniform_fan_in(keys[8], h, (h, v)),
+    }
+
+
+def param_count(params: Params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+# ---------------------------------------------------------------------------
+# Forward pieces (granular so PP schedules can compose them)
+# ---------------------------------------------------------------------------
+
+
+def _compute_dtype(cfg: ModelConfig):
+    return jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+
+
+def embed(params: Params, input_ids: jnp.ndarray, cfg: ModelConfig,
+          ctx: ParallelCtx = DEFAULT_CTX) -> jnp.ndarray:
+    """Token embedding -> [B, S, H] in compute dtype."""
+    w = params["embedding"]
+    if ctx.embed_lookup is not None:
+        x = ctx.embed_lookup(w, input_ids)
+    else:
+        x = w[input_ids]
+    return x.astype(_compute_dtype(cfg))
+
+
+def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
+    """RMSNorm -> qkv -> RoPE -> attention -> out_proj (ref: model.py:122-162)."""
+    dt = x.dtype
+    b, s, _ = x.shape
+    d = cfg.head_dim
+
+    h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+    h = ctx.f(h)  # column-parallel entry: identity fwd / psum-over-tp bwd
+    q = h @ lp["q"].astype(dt)
+    k = h @ lp["k"].astype(dt)
+    v = h @ lp["v"].astype(dt)
+
+    # local head counts come from the (possibly TP-sharded) weight shapes
+    n_q = q.shape[-1] // d
+    n_kv = k.shape[-1] // d
+    q = q.reshape(b, s, n_q, d)
+    k = k.reshape(b, s, n_kv, d)
+    v = v.reshape(b, s, n_kv, d)
+
+    q = apply_rope(q, cos, sin, ctx.positions)
+    k = apply_rope(k, cos, sin, ctx.positions)
+    # K/V stay unexpanded (n_kv heads) — attention impls handle GQA so the
+    # CP ring permutes and flash streams the small K/V.
+    out = ctx.attn(q, k, v, ctx.positions)  # [B, S, n_q, D]
+    out = out.reshape(b, s, n_q * d)
+    out = out @ lp["o"].astype(dt)
+    return ctx.g(out)  # row-parallel exit: psum-over-tp fwd / identity bwd
+
+
+def _mlp_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx):
+    """RMSNorm -> SwiGLU (ref: model.py:184-186)."""
+    dt = x.dtype
+    h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
+    h = ctx.f(h)
+    gate = h @ lp["gate"].astype(dt)
+    up = h @ lp["up"].astype(dt)
+    out = (jax.nn.silu(gate) * up) @ lp["down"].astype(dt)
+    return ctx.g(out)
+
+
+def decoder_layer(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
+    x = x + _attention_block(x, lp, cfg, ctx, cos, sin)
+    x = x + _mlp_block(x, lp, cfg, ctx)
+    return x
+
+
+def run_layers(layer_params: Params, x: jnp.ndarray, cfg: ModelConfig,
+               ctx: ParallelCtx = DEFAULT_CTX,
+               cos: jnp.ndarray | None = None,
+               sin: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Scan a stacked layer pytree over x. Works on any contiguous stage
+    slice, which is exactly what pipeline parallelism feeds it."""
+    if cos is None:
+        cos, sin = rope_tables(cfg.max_position_embeddings, cfg.head_dim,
+                               cfg.rope_theta)
+
+    def body(h, lp):
+        return decoder_layer(h, lp, cfg, ctx, cos, sin), None
+
+    if ctx.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, layer_params)
+    return x
+
+
+def final_hidden(params: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+
+
+def logits_from_hidden(params: Params, x: jnp.ndarray, cfg: ModelConfig,
+                       ctx: ParallelCtx = DEFAULT_CTX) -> jnp.ndarray:
+    logits = x @ params["lm_head"].astype(x.dtype)
+    return ctx.gather_logits(logits)
+
+
+# ---------------------------------------------------------------------------
+# Convenience compositions
+# ---------------------------------------------------------------------------
+
+
+def forward(params: Params, input_ids: jnp.ndarray, cfg: ModelConfig,
+            ctx: ParallelCtx = DEFAULT_CTX) -> jnp.ndarray:
+    """input_ids [B, S] -> logits [B, S, V] (full vocab; eval/debug path)."""
+    cos, sin = rope_tables(cfg.max_position_embeddings, cfg.head_dim, cfg.rope_theta)
+    x = embed(params, input_ids, cfg, ctx)
+    x = run_layers(params["layers"], x, cfg, ctx, cos, sin)
+    x = final_hidden(params, x, cfg)
+    return logits_from_hidden(params, x, cfg, ctx)
+
+
+def loss_fn(params: Params, input_ids: jnp.ndarray, targets: jnp.ndarray,
+            cfg: ModelConfig, ctx: ParallelCtx = DEFAULT_CTX) -> jnp.ndarray:
+    """Token-mean cross-entropy training loss (ref: train.py:43-49).
+
+    Under TP, `ctx.head_ce` computes the loss against vocab-sharded logits
+    without materializing the full-vocab gather.
+    """
+    cos, sin = rope_tables(cfg.max_position_embeddings, cfg.head_dim, cfg.rope_theta)
+    x = embed(params, input_ids, cfg, ctx)
+    x = run_layers(params["layers"], x, cfg, ctx, cos, sin)
+    x = final_hidden(params, x, cfg)
+    if ctx.head_ce is not None:
+        return ctx.head_ce(x, params["lm_head"], targets)
+    logits = x @ params["lm_head"].astype(x.dtype)
+    return cross_entropy(logits, targets)

@@ -1,0 +1,10 @@
+"""Core numerical ops: RoPE, RMSNorm, attention implementations, losses.
+
+TPU-native replacements for the reference's imported CUDA/Triton kernels
+(SURVEY.md §2.3): flash-attn -> Pallas flash attention, Triton RMSNorm ->
+jnp RMSNorm (XLA fuses it), fused rotary -> jnp rotary fused by XLA.
+"""
+
+from picotron_tpu.ops.rope import rope_tables, apply_rope  # noqa: F401
+from picotron_tpu.ops.rmsnorm import rms_norm  # noqa: F401
+from picotron_tpu.ops.attention import sdpa_attention  # noqa: F401

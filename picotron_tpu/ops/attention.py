@@ -1,0 +1,87 @@
+"""Reference attention path: plain jnp scaled-dot-product attention with GQA.
+
+This is the TPU analogue of the reference's SDPA fallback
+(ref: picotron/model.py:155-158) and doubles as the ground truth that the
+Pallas flash kernel and the context-parallel ring are tested against
+(the reference tests TP the same way, against an unsharded nn.Linear).
+
+Softmax statistics are computed in fp32 regardless of input dtype. The
+log-sum-exp can be returned so the context-parallel ring can merge partial
+results across K/V blocks (ref: context_parallel.py:112-128 keeps LSE for the
+same reason).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """GQA: expand kv heads to match query heads.
+
+    x: [batch, seq, kv_heads, head_dim] -> [batch, seq, kv_heads*n_rep, head_dim]
+    (ref: model.py:142-143 uses repeat_interleave on the head axis).
+    """
+    if n_rep == 1:
+        return x
+    b, s, kv, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, kv, n_rep, d)).reshape(b, s, kv * n_rep, d)
+
+
+def sdpa_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    q_positions: jnp.ndarray | None = None,
+    kv_positions: jnp.ndarray | None = None,
+    return_lse: bool = False,
+    sm_scale: float | None = None,
+):
+    """Scaled dot-product attention.
+
+    q: [batch, q_len, q_heads, head_dim]
+    k, v: [batch, kv_len, kv_heads, head_dim] — kv_heads may be smaller than
+        q_heads (GQA); the expansion happens here, NOT in the caller, so
+        parallel implementations (CP ring, flash kernel) can move/stream the
+        small unexpanded K/V.
+    q_positions/kv_positions: optional global position vectors; the causal
+        mask is `q_pos >= kv_pos`, which generalizes to context-parallel
+        shards where local index != global position.
+
+    Returns out [batch, q_len, q_heads, head_dim] (and lse
+    [batch, q_heads, q_len] fp32 if return_lse).
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if k.shape[2] != h:
+        k = repeat_kv(k, h // k.shape[2])
+        v = repeat_kv(v, h // v.shape[2])
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+
+    # [B, H, Sq, Sk] in fp32 for stable softmax
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * sm_scale
+
+    if causal:
+        qp = q_positions if q_positions is not None else jnp.arange(sq)
+        kp = kv_positions if kv_positions is not None else jnp.arange(sk)
+        mask = qp[:, None] >= kp[None, :]  # [Sq, Sk]
+        scores = jnp.where(mask[None, None, :, :], scores, -jnp.inf)
+
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    # Fully-masked rows (non-square blocks in the CP ring) have m = -inf and
+    # l = 0; they must produce out = 0 with lse = -inf so the ring's LSE merge
+    # assigns them zero weight — not NaN from 0/0 or exp(-inf - -inf).
+    m_safe = jnp.maximum(m, -1e30)
+    p = jnp.exp(scores - m_safe)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = jnp.einsum("bhqk,bkhd->bqhd", (p / l_safe).astype(v.dtype), v,
+                     preferred_element_type=jnp.float32).astype(q.dtype)
+    if return_lse:
+        lse = jnp.where(l == 0.0, -jnp.inf, m_safe + jnp.log(l_safe)).squeeze(-1)
+        return out, lse  # lse: [B, H, Sq] fp32
+    return out

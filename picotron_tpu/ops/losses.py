@@ -1,0 +1,36 @@
+"""Cross-entropy losses.
+
+`cross_entropy`: the plain full-vocab loss (ref: train.py:49 and
+pipeline_parallel.py:102-104 use F.cross_entropy over flattened logits).
+Computed in fp32 with an ignore_index mask matching torch's default semantics
+(mean over non-ignored tokens).
+
+The vocab-parallel variant (no full-logit materialization — an improvement
+over the reference's TP gather, ref: tensor_parallel.py:50) lives in
+picotron_tpu/parallel/tp.py next to the TP collectives it needs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100
+
+
+def cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Token-mean cross entropy.
+
+    logits: [..., vocab] (any float dtype; upcast to fp32)
+    targets: [...] int labels, IGNORE_INDEX entries excluded from the mean.
+    """
+    logits = logits.astype(jnp.float32)
+    valid = targets != IGNORE_INDEX
+    safe_targets = jnp.where(valid, targets, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    label_logit = jnp.take_along_axis(
+        logits, safe_targets[..., None], axis=-1
+    ).squeeze(-1)
+    nll = jnp.where(valid, logz - label_logit, 0.0)
+    count = jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(nll) / count

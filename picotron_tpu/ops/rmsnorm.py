@@ -1,0 +1,22 @@
+"""RMSNorm with fp32 statistics.
+
+Reference semantics (ref: picotron/model.py:67-86): compute variance in fp32,
+normalize, scale by a learned weight, return in the input dtype. On TPU a
+plain jnp implementation fuses into surrounding ops under XLA, playing the
+role of the reference's Triton kernel (ref: model.py:39-65) with zero custom
+code; a Pallas variant is unnecessary (bandwidth-bound op, XLA emits an
+optimal fusion).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    variance = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * lax.rsqrt(variance + eps)
+    return (weight.astype(jnp.float32) * normed).astype(dtype)

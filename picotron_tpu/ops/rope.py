@@ -1,0 +1,72 @@
+"""Rotary position embeddings.
+
+Matches the reference semantics (ref: picotron/model.py:12-31): non-interleaved
+"rotate-half" RoPE with HF-compatible frequencies, tables computed in fp32 and
+cast to the compute dtype at application time. One table pair serves all
+layers (the reference recomputes identical tables per layer,
+ref: model.py:199 — a pure waste we drop).
+
+For context parallelism each cp shard applies the table rows of its own
+contiguous sequence slice (ref: context_parallel.py:189-195); callers pass the
+global positions of their local tokens instead of slicing tables by hand.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_tables(max_seq_len: int, head_dim: int, base: float = 10000.0):
+    """Precompute cos/sin tables, shape [max_seq_len, head_dim // 2], fp32."""
+    assert head_dim % 2 == 0, "head_dim must be even for RoPE"
+    exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    inv_freq = 1.0 / (base ** exponent)  # [head_dim/2]
+    positions = jnp.arange(max_seq_len, dtype=jnp.float32)[:, None]  # [S, 1]
+    angles = positions * inv_freq[None, :]  # [S, head_dim/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+               positions: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Apply rotate-half RoPE.
+
+    x:    [batch, seq, heads, head_dim]
+    cos/sin: [max_seq, head_dim/2] tables from `rope_tables`
+    positions: optional [seq] global positions of the local tokens (for CP
+        shards); defaults to 0..seq-1.
+
+    Equivalent to the reference's `x * cos + rotate_half(x) * sin` with
+    `cos/sin` repeated (1,2) (ref: model.py:12-19,31) — written on the
+    half-tables directly so no materialized repeat is needed.
+    """
+    seq_len = x.shape[1]
+    if positions is None:
+        if seq_len > cos.shape[0]:
+            raise ValueError(
+                f"sequence length {seq_len} exceeds the RoPE table length "
+                f"{cos.shape[0]} (max_position_embeddings)"
+            )
+        c = cos[:seq_len]
+        s = sin[:seq_len]
+    else:
+        # Bounds-check when positions are concrete (tracers — e.g. computed
+        # from axis_index inside shard_map — can't be checked at trace time;
+        # out-of-range gathers would silently clamp).
+        if not isinstance(positions, jax.core.Tracer):
+            pmax = int(jnp.max(positions))
+            if pmax >= cos.shape[0]:
+                raise ValueError(
+                    f"position {pmax} exceeds the RoPE table length {cos.shape[0]}"
+                )
+        c = cos[positions]
+        s = sin[positions]
+    c = c[None, :, None, :]  # [1, S, 1, D/2]
+    s = s[None, :, None, :]
+    half = x.shape[-1] // 2
+    x1 = x[..., :half]
+    x2 = x[..., half:]
+    # (x1, x2) * repeat(cos,2) + (-x2, x1) * repeat(sin,2)
+    out1 = x1 * c - x2 * s
+    out2 = x2 * c + x1 * s
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)

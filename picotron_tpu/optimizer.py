@@ -1,0 +1,27 @@
+"""Optimizer: AdamW over fp32 master params.
+
+The reference uses `torch.optim.AdamW(fused=True)` (ref: train.py:204-209) —
+a CUDA kernel. On TPU, optax's adamw update is a handful of elementwise ops
+that XLA fuses into one kernel per bucket automatically; no custom kernel is
+needed (SURVEY.md §2.3 row `fused AdamW`).
+"""
+
+from __future__ import annotations
+
+import optax
+
+from picotron_tpu.config import TrainingConfig
+
+
+def make_optimizer(t: TrainingConfig) -> optax.GradientTransformation:
+    steps = [] if t.grad_clip_norm <= 0 else [optax.clip_by_global_norm(t.grad_clip_norm)]
+    steps.append(
+        optax.adamw(
+            learning_rate=t.learning_rate,
+            b1=t.adam_beta1,
+            b2=t.adam_beta2,
+            eps=t.adam_eps,
+            weight_decay=t.weight_decay,
+        )
+    )
+    return optax.chain(*steps)

@@ -1,0 +1,70 @@
+"""Single-device training step: grad-accumulation scan + AdamW update.
+
+Parity with the reference's non-PP `train_step` (ref: train.py:29-55): loop
+over gradient-accumulation microbatches, average the loss, one optimizer step.
+The Python for-loop with a grad-sync flag becomes a `lax.scan` accumulating
+fp32 gradients — the deferred-allreduce semantics the reference implements
+with `require_backward_grad_sync` (ref: train.py:41, data_parallel.py:80) are
+simply "psum once, after the scan" in the SPMD version
+(see picotron_tpu/parallel/api.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from picotron_tpu.config import Config
+from picotron_tpu.models.llama import DEFAULT_CTX, ParallelCtx, loss_fn
+from picotron_tpu.optimizer import make_optimizer
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray  # int32 scalar
+
+
+def init_train_state(cfg: Config, params) -> TrainState:
+    opt = make_optimizer(cfg.training)
+    return TrainState(params=params, opt_state=opt.init(params),
+                      step=jnp.zeros((), jnp.int32))
+
+
+def accumulate_grads(params, batch, cfg: Config, ctx: ParallelCtx):
+    """Scan microbatches, accumulating fp32 grads and the mean loss.
+
+    batch: (input_ids, targets), each [n_micro, mbs, seq].
+    """
+    n_micro = batch[0].shape[0]
+
+    def micro_step(carry, mb):
+        grads_acc, loss_acc = carry
+        ids, tgt = mb
+        loss, grads = jax.value_and_grad(loss_fn)(params, ids, tgt, cfg.model, ctx)
+        grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+        return (grads_acc, loss_acc + loss), None
+
+    zero_grads = jax.tree.map(jnp.zeros_like, params)
+    (grads, loss_sum), _ = jax.lax.scan(
+        micro_step, (zero_grads, jnp.zeros((), jnp.float32)), batch
+    )
+    scale = 1.0 / n_micro
+    grads = jax.tree.map(lambda g: g * scale, grads)
+    return grads, loss_sum * scale
+
+
+def make_train_step(cfg: Config, ctx: ParallelCtx = DEFAULT_CTX):
+    """Build a jittable (state, batch) -> (state, loss) single-device step."""
+    opt = make_optimizer(cfg.training)
+
+    def train_step(state: TrainState, batch):
+        grads, loss = accumulate_grads(state.params, batch, cfg, ctx)
+        updates, opt_state = opt.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return train_step
