@@ -1,0 +1,19 @@
+"""Parallelism layers: TP collectives, sharding specs, PP schedules, CP ring,
+and the composed 4D train step.
+
+Where the reference implements each parallelism as a model-surgery wrapper
+plus hand-written autograd collectives (SURVEY.md §2 rows 4-11), here:
+
+- DP/TP are *declarative*: parameter PartitionSpecs + named-axis collectives
+  inside one `shard_map`; gradient synchronization is just differentiating
+  through `lax.pmean(loss, ('dp', 'cp'))` — JAX's varying-manual-axes
+  machinery transposes the collectives, which is what the reference builds by
+  hand as CopyTo/ReduceFrom/GatherFrom autograd Functions
+  (ref: tp_communications.py) and bucketed gradient hooks
+  (ref: data_parallel.py, bucket.py).
+- PP/CP are *choreographed*: ppermute schedules over the 'pp'/'cp' axes
+  (parallel/pp.py, ops/ring_attention.py).
+"""
+
+from picotron_tpu.parallel.sharding import param_specs, batch_spec  # noqa: F401
+from picotron_tpu.parallel.api import make_train_step, make_parallel_ctx  # noqa: F401

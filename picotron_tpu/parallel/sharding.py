@@ -1,0 +1,66 @@
+"""Parameter and batch PartitionSpecs over the (dp, pp, cp, tp) mesh.
+
+This module is the declarative heart of DP/TP/PP: where the reference
+surgically replaces nn.Linear modules with Column/Row/VocabParallel classes
+(ref: tensor_parallel.py:9-52) and slices layer stacks per pipeline rank
+(ref: pipeline_parallel.py:13-51), here one pytree of PartitionSpecs says
+where every parameter lives and GSPMD materializes exactly that shard per
+device:
+
+- column-parallel (q/k/v/gate/up): output features on 'tp'
+- row-parallel (o/down): input features on 'tp'
+- vocab-parallel (embedding, lm_head): vocab dim on 'tp'
+- stacked decoder layers: leading layer axis on 'pp' (the reference's
+  contiguous stage slices, ref: pipeline_parallel.py:42-51, as a sharding)
+- norms: replicated over tp (sequence-parallel sharding is a future option)
+- everything: replicated over dp and cp (they are data axes; ZeRO-style
+  param sharding over dp is a deliberate non-goal for parity — SURVEY.md
+  §2.2 marks FSDP absent in the reference)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from picotron_tpu.config import Config
+
+
+def param_specs(cfg: Config) -> dict[str, Any]:
+    """PartitionSpec pytree matching models.llama.init_params' structure."""
+    pp = "pp" if cfg.distributed.pp_size > 1 else None
+    if cfg.distributed.pp_size > 1:
+        if cfg.model.num_hidden_layers % cfg.distributed.pp_size != 0:
+            raise ValueError(
+                "num_hidden_layers must be divisible by pp_size (stacked stage "
+                f"sharding): {cfg.model.num_hidden_layers} % {cfg.distributed.pp_size}"
+            )
+    return {
+        "embedding": P("tp", None),
+        "layers": {
+            "input_norm": P(pp, None),
+            "q": P(pp, None, "tp"),
+            "k": P(pp, None, "tp"),
+            "v": P(pp, None, "tp"),
+            "o": P(pp, "tp", None),
+            "post_norm": P(pp, None),
+            "gate": P(pp, None, "tp"),
+            "up": P(pp, None, "tp"),
+            "down": P(pp, "tp", None),
+        },
+        "final_norm": P(),
+        "lm_head": P(None, "tp"),
+    }
+
+
+def batch_spec() -> P:
+    """[n_micro, batch, seq] token blocks: batch over dp, sequence over cp
+    (the contiguous CP split, ref: data.py:105-109, as a sharding)."""
+    return P(None, "dp", "cp")
+
+
+def param_shardings(cfg: Config, mesh) -> dict[str, Any]:
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), param_specs(cfg),
+                        is_leaf=lambda x: isinstance(x, P))

@@ -1,0 +1,86 @@
+"""Tensor parallelism: vocab-parallel embedding, sharded cross-entropy, and
+the TP hooks for ParallelCtx.
+
+Megatron-style 1D TP (capability parity with ref: picotron/tensor_parallel/):
+
+- Column-parallel linears (q/k/v/gate/up) shard the output features over
+  'tp'; row-parallel linears (o/down) shard the input features and psum the
+  partial outputs (ref: tensor_parallel.py:54-189). In this framework the
+  *sharding specs* (parallel/sharding.py) put the weights on the mesh and the
+  only explicit collective needed in the forward is the row-parallel exit
+  psum — the backward psum of the column-parallel entry
+  (ref: tp_communications.py:19-33, the `f` function) is inserted
+  automatically when JAX transposes the psum/pvary pair under shard_map.
+
+- The vocab-parallel embedding masks out-of-shard tokens and psums
+  (ref: tensor_parallel.py:191-271 does the same with an explicit mask +
+  all-reduce).
+
+- `vocab_parallel_ce` improves on the reference, which all-gathers full-vocab
+  logits on every rank before cross-entropy (ref: tensor_parallel.py:50
+  `gather_output=True` + train.py:49): we compute the softmax statistics with
+  a pmax/psum pair and never materialize the gathered [B, S, V] tensor —
+  at SmolLM's 49k vocab this saves tp x the logit memory and an all-gather
+  per microbatch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from picotron_tpu.ops.losses import IGNORE_INDEX
+
+
+def vocab_parallel_embed(w_shard: jnp.ndarray, ids: jnp.ndarray,
+                         axis: str = "tp") -> jnp.ndarray:
+    """Embedding lookup with the vocab dimension sharded over `axis`.
+
+    w_shard: [vocab/tp, hidden] local shard; ids replicated.
+    Out-of-shard ids contribute zero; psum over tp assembles the full row.
+    """
+    vshard = w_shard.shape[0]
+    lo = lax.axis_index(axis) * vshard
+    rel = ids - lo
+    ok = (rel >= 0) & (rel < vshard)
+    rel = jnp.clip(rel, 0, vshard - 1)
+    x = w_shard[rel] * ok[..., None].astype(w_shard.dtype)
+    return lax.psum(x, axis)
+
+
+def vocab_parallel_ce(hidden: jnp.ndarray, head_shard: jnp.ndarray,
+                      targets: jnp.ndarray, axis: str = "tp") -> jnp.ndarray:
+    """Token-mean cross-entropy against a vocab-sharded LM head.
+
+    hidden: [B, S, H] (replicated over tp); head_shard: [H, vocab/tp];
+    targets: [B, S] with IGNORE_INDEX allowed. Returns a scalar replicated
+    over tp. Matches ops.losses.cross_entropy numerically.
+    """
+    logits = (hidden @ head_shard.astype(hidden.dtype)).astype(jnp.float32)
+    vshard = logits.shape[-1]
+    lo = lax.axis_index(axis) * vshard
+
+    # logsumexp over the full (sharded) vocab: pmax for the max, psum for the
+    # sum of exponentials. stop_gradient on the max (standard softmax trick —
+    # the max's gradient contribution cancels exactly).
+    m = lax.pmax(jax.lax.stop_gradient(jnp.max(logits, axis=-1)), axis)  # [B,S]
+    sumexp = lax.psum(jnp.sum(jnp.exp(logits - m[..., None]), axis=-1), axis)
+    logz = m + jnp.log(sumexp)  # [B, S]
+
+    valid = targets != IGNORE_INDEX
+    rel = jnp.where(valid, targets, 0) - lo
+    ok = (rel >= 0) & (rel < vshard)
+    relc = jnp.clip(rel, 0, vshard - 1)
+    local_label = jnp.take_along_axis(logits, relc[..., None], axis=-1).squeeze(-1)
+    label_logit = lax.psum(local_label * ok.astype(jnp.float32), axis)
+
+    nll = jnp.where(valid, logz - label_logit, 0.0)
+    count = jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(nll) / count
+
+
+def gather_logits(logits: jnp.ndarray, axis: str = "tp") -> jnp.ndarray:
+    """all-gather vocab-sharded logits to full vocab on the last dim (the
+    eval/debug path; ref: tp_communications.py:51-64 GatherFromModelParallel)."""
+    return lax.all_gather(logits, axis, axis=logits.ndim - 1, tiled=True)
