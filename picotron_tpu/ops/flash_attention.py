@@ -1,0 +1,440 @@
+"""Pallas flash attention for TPU: blockwise causal attention with LSE export.
+
+TPU-native replacement for the reference's imported flash-attn CUDA kernel
+(ref: picotron/model.py:7,33-37,152-154 calls flash_attn_func; SURVEY.md §2.3
+row 1 requires a first-class equivalent). Same contract as
+`ops.attention.sdpa_attention` — including `return_lse` — so it slots into
+`ParallelCtx.attn` directly and into the context-parallel ring as the
+per-block kernel (ref: the CP ring's pure-torch blockwise math + TODOs
+wishing for flash, context_parallel.py:22-23,112-155).
+
+Design:
+- Inputs [B, S, H, D] are viewed [B, H, S, D]; the grid runs one program per
+  (batch, q-head, q-block). K/V for the whole (cp-local) sequence sit in
+  VMEM; the kernel loops KV blocks with online-softmax (m, l, acc) updates —
+  the standard flash recurrence.
+- **GQA in the index map**: the K/V BlockSpecs map q-head h to kv-head
+  h // (Hq // Hkv), so grouped heads never materialize (the reference
+  repeat_interleaves K/V to full Hq first, model.py:142-143).
+- **Masking by explicit positions**, not block indices: the causal mask is
+  `q_pos >= kv_pos` on position vectors, so context-parallel shards (local
+  index != global position) and future zigzag layouts reuse the same kernel.
+  Blocks that are entirely masked skip their matmuls via `pl.when`.
+- **Custom VJP with Pallas backward kernels**: dq via a q-block-parallel
+  kernel, dk/dv via a kv-block-parallel kernel, both recomputing P from the
+  saved LSE (flash-attn 2's backward structure; no S x S materialization).
+
+Numerics: fp32 accumulation for scores/softmax/output regardless of input
+dtype, matching sdpa_attention.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+_NEG_INF = -1e30
+
+
+def _pick_block(s: int, preferred: int) -> int:
+    b = min(preferred, s)
+    while s % b != 0:
+        b //= 2
+    return max(b, 1)
+
+
+def _out_struct(shape, dtype, *operands):
+    """ShapeDtypeStruct whose `vma` is the union of the operands' varying
+    mesh axes — required for pallas_call under shard_map(check_vma=True)
+    (the CP ring runs this kernel on 'cp'-varying blocks)."""
+    vma = frozenset()
+    for x in operands:
+        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(kmin_ref, qpos_ref, kpos_ref, q_ref, k_ref, v_ref, o_ref,
+                lse_ref, *, sm_scale: float, block_k: int, causal: bool):
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale          # [BQ, D]
+    bq = q.shape[0]
+    sk = k_ref.shape[2]
+    qpos = qpos_ref[0]                                       # [BQ]
+    num_kv = sk // block_k
+
+    m = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l = jnp.zeros((bq,), jnp.float32)
+    acc = jnp.zeros((bq, q.shape[1]), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+        kpos = kpos_ref[0, pl.ds(j * block_k, block_k)]      # [BK]
+
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [BQ, BK]
+        if causal:
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask, s, _NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)                            # exp(-inf-(-inf))
+        alpha = jnp.where(m <= _NEG_INF, 0.0, alpha)          # guarded to 0
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(m_new[:, None] <= _NEG_INF, 0.0, p)
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        return m_new, l, acc
+
+    if causal:
+        # Skip blocks with no unmasked entry. Per-block position minima come
+        # from SMEM (kmin_ref) — Mosaic cannot prove lane alignment for a
+        # dynamic scalar load from the VMEM position vector.
+        q_hi = jnp.max(qpos)
+
+        def guarded(j, carry):
+            k_lo = kmin_ref[0, j]
+            return jax.lax.cond(q_hi >= k_lo, lambda c: body(j, c),
+                                lambda c: c, carry)
+
+        m, l, acc = jax.lax.fori_loop(0, num_kv, guarded, (m, l, acc))
+    else:
+        m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m, l, acc))
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # True -inf for fully-masked rows — the CP ring's LSE merge keys on
+    # isinf, matching sdpa_attention's convention.
+    lse = jnp.where(l == 0.0, -jnp.inf, m + jnp.log(l_safe))
+    lse_ref[0, 0] = lse.astype(jnp.float32)[:, None]
+
+
+def _fwd(q4, k4, v4, qpos, kpos, sm_scale, causal, block_q, block_k,
+         interpret):
+    """q4 [B,Hq,Sq,D]; k4/v4 [B,Hkv,Sk,D]; qpos [1,Sq]; kpos [1,Sk]."""
+    b, hq, sq, d = q4.shape
+    hkv, sk = k4.shape[1], k4.shape[2]
+    n_rep = hq // hkv
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+
+    grid = (b, hq, sq // bq)
+    kmin = kpos.reshape(1, sk // bk, bk).min(axis=-1)  # [1, num_kv_blocks]
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, block_k=bk, causal=causal)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),                  # kmin
+            pl.BlockSpec((1, bq), lambda bi, hi, qi: (0, qi)),      # qpos
+            pl.BlockSpec((1, sk), lambda bi, hi, qi: (0, 0)),       # kpos
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, sk, d),
+                         lambda bi, hi, qi, n_rep=n_rep: (bi, hi // n_rep, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d),
+                         lambda bi, hi, qi, n_rep=n_rep: (bi, hi // n_rep, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            _out_struct((b, hq, sq, d), q4.dtype, q4, k4, v4, qpos, kpos),
+            _out_struct((b, hq, sq, 1), jnp.float32, q4, k4, v4, qpos, kpos),
+        ],
+        interpret=interpret,
+    )(kmin, qpos, kpos, q4, k4, v4)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (flash-attn 2 structure: recompute P from saved LSE)
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(kmin_ref, qpos_ref, kpos_ref, q_ref, k_ref, v_ref,
+                   do_ref, lse_ref, delta_ref, dq_ref, *, sm_scale: float,
+                   block_k: int, causal: bool):
+    q = q_ref[0, 0].astype(jnp.float32)                      # [BQ, D]
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, 0]                                # [BQ]
+    delta = delta_ref[0, 0, :, 0]                            # [BQ]
+    qpos = qpos_ref[0]
+    bq = q.shape[0]
+    sk = k_ref.shape[2]
+    num_kv = sk // block_k
+
+    dq = jnp.zeros_like(q)
+
+    def body(j, dq):
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+        kpos = kpos_ref[0, pl.ds(j * block_k, block_k)]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(lse[:, None] <= _NEG_INF, 0.0, p)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        q_hi = jnp.max(qpos)
+
+        def guarded(j, dq):
+            k_lo = kmin_ref[0, j]
+            return jax.lax.cond(q_hi >= k_lo, lambda c: body(j, c),
+                                lambda c: c, dq)
+
+        dq = jax.lax.fori_loop(0, num_kv, guarded, dq)
+    else:
+        dq = jax.lax.fori_loop(0, num_kv, body, dq)
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(qmax_ref, qpos_ref, kpos_ref, q_ref, k_ref, v_ref,
+                    do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
+                    sm_scale: float, block_q: int, causal: bool):
+    k_blk = k_ref[0, 0].astype(jnp.float32)                  # [BK, D]
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    kpos = kpos_ref[0]                                       # [BK]
+    sq = q_ref.shape[2]
+    bk = k_blk.shape[0]
+    num_q = sq // block_q
+
+    dk = jnp.zeros_like(k_blk)
+    dv = jnp.zeros_like(v_blk)
+
+    def body(i, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, 0, pl.ds(i * block_q, block_q)].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(i * block_q, block_q)].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), 0]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), 0]
+        qpos = qpos_ref[0, pl.ds(i * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale    # [BQ, BK]
+        if causal:
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(lse[:, None] <= _NEG_INF, 0.0, p)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        k_lo = jnp.min(kpos)
+
+        def guarded(i, carry):
+            q_hi = qmax_ref[0, i]
+            return jax.lax.cond(q_hi >= k_lo, lambda c: body(i, c),
+                                lambda c: c, carry)
+
+        dk, dv = jax.lax.fori_loop(0, num_q, guarded, (dk, dv))
+    else:
+        dk, dv = jax.lax.fori_loop(0, num_q, body, (dk, dv))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(q4, k4, v4, o4, lse, do4, dlse, qpos, kpos, sm_scale, causal,
+         block_q, block_k, interpret):
+    b, hq, sq, d = q4.shape
+    hkv, sk = k4.shape[1], k4.shape[2]
+    n_rep = hq // hkv
+    bq = _pick_block(sq, block_q)
+    bk = _pick_block(sk, block_k)
+
+    # delta = rowsum(do * o) [B, Hq, Sq] (flash-attn 2's D term). The LSE
+    # cotangent folds in here: dL/ds_ij = p_ij * (dp_ij - delta_i + dlse_i)
+    # because dlse_i/ds_ij = p_ij — so shipping (delta - dlse) to the kernels
+    # handles out- and lse-cotangents in one pass (the CP ring's LSE merge
+    # differentiates through both).
+    delta = jnp.sum(do4.astype(jnp.float32) * o4.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    delta = delta - dlse.astype(jnp.float32)
+
+    kmin = kpos.reshape(1, sk // bk, bk).min(axis=-1)
+    qmax = qpos.reshape(1, sq // bq, bq).max(axis=-1)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, block_k=bk,
+                          causal=causal),
+        grid=(b, hq, sq // bq),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq), lambda bi, hi, qi: (0, qi)),
+            pl.BlockSpec((1, sk), lambda bi, hi, qi: (0, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, sk, d),
+                         lambda bi, hi, qi, n_rep=n_rep: (bi, hi // n_rep, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d),
+                         lambda bi, hi, qi, n_rep=n_rep: (bi, hi // n_rep, 0, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=_out_struct((b, hq, sq, d), q4.dtype,
+                              q4, k4, v4, do4, lse, delta, qpos, kpos),
+        interpret=interpret,
+    )(kmin, qpos, kpos, q4, k4, v4, do4, lse, delta)
+
+    # dk/dv over full query heads, then sum grouped heads for GQA.
+    dk_full, dv_full = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, block_q=bq,
+                          causal=causal),
+        grid=(b, hq, sk // bk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, sq), lambda bi, hi, ki: (0, 0)),
+            pl.BlockSpec((1, bk), lambda bi, hi, ki: (0, ki)),
+            pl.BlockSpec((1, 1, sq, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, ki, n_rep=n_rep: (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, ki, n_rep=n_rep: (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, sq, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, sq, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, sq, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            _out_struct((b, hq, sk, d), q4.dtype,
+                        q4, k4, v4, do4, lse, delta, qpos, kpos),
+            _out_struct((b, hq, sk, d), q4.dtype,
+                        q4, k4, v4, do4, lse, delta, qpos, kpos),
+        ],
+        interpret=interpret,
+    )(qmax, qpos, kpos, q4, k4, v4, do4, lse, delta)
+
+    if n_rep > 1:
+        dk = dk_full.reshape(b, hkv, n_rep, sk, d).sum(axis=2)
+        dv = dv_full.reshape(b, hkv, n_rep, sk, d).sum(axis=2)
+    else:
+        dk, dv = dk_full, dv_full
+    return dq, dk.astype(k4.dtype), dv.astype(v4.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Public API with custom VJP
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_core(q4, k4, v4, qpos, kpos, sm_scale, causal, block_q, block_k,
+                interpret):
+    return _fwd(q4, k4, v4, qpos, kpos, sm_scale, causal, block_q, block_k,
+                interpret)
+
+
+def _flash_core_fwd(q4, k4, v4, qpos, kpos, sm_scale, causal, block_q,
+                    block_k, interpret):
+    out, lse = _fwd(q4, k4, v4, qpos, kpos, sm_scale, causal, block_q,
+                    block_k, interpret)
+    return (out, lse), (q4, k4, v4, out, lse, qpos, kpos)
+
+
+def _flash_core_bwd(sm_scale, causal, block_q, block_k, interpret, res, cts):
+    q4, k4, v4, out, lse, qpos, kpos = res
+    do4, dlse = cts
+    dq, dk, dv = _bwd(q4, k4, v4, out, lse, do4, dlse, qpos, kpos, sm_scale,
+                      causal, block_q, block_k, interpret)
+    return dq, dk, dv, None, None
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    q_positions: Optional[jnp.ndarray] = None,
+    kv_positions: Optional[jnp.ndarray] = None,
+    return_lse: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
+):
+    """Drop-in flash counterpart of `sdpa_attention` (same shapes/semantics):
+    q [B, Sq, Hq, D]; k/v [B, Sk, Hkv, D] (GQA unexpanded); optional global
+    position vectors for CP shards. Returns out (and fp32 lse [B, Hq, Sq]).
+
+    Backend dispatch: on TPU the Pallas kernels run compiled. On other
+    backends (the simulated-mesh test platform) the mathematically identical
+    jnp path runs instead — Pallas interpreter mode does not compose with
+    shard_map's varying-axis checking, and tests/test_flash_attention.py
+    pins kernel==jnp equivalence in interpreter mode directly. Pass
+    `interpret=True` to force the Pallas interpreter (kernel unit tests).
+    """
+    b, sq, hq, d = q.shape
+    sk = k.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+    if interpret is None and jax.default_backend() != "tpu":
+        from picotron_tpu.ops.attention import sdpa_attention
+
+        return sdpa_attention(
+            q, k, v, causal=causal, q_positions=q_positions,
+            kv_positions=kv_positions, return_lse=return_lse,
+            sm_scale=sm_scale)
+    interpret = bool(interpret)
+    qpos = (q_positions if q_positions is not None else jnp.arange(sq))
+    kpos = (kv_positions if kv_positions is not None else jnp.arange(sk))
+    qpos = qpos.astype(jnp.int32).reshape(1, sq)
+    kpos = kpos.astype(jnp.int32).reshape(1, sk)
+
+    q4 = jnp.swapaxes(q, 1, 2)
+    k4 = jnp.swapaxes(k, 1, 2)
+    v4 = jnp.swapaxes(v, 1, 2)
+
+    out, lse = _flash_core(q4, k4, v4, qpos, kpos, sm_scale, causal, block_q,
+                           block_k, interpret)
+    out = jnp.swapaxes(out, 1, 2)
+    if return_lse:
+        # LSE is the *scaled-score* logsumexp, same convention as
+        # sdpa_attention (which also applies sm_scale before the softmax).
+        # Kernels carry it [B, Hq, Sq, 1] (TPU block-shape constraint);
+        # drop the trailing dim at the boundary.
+        return out, lse[..., 0]
+    return out

@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Real-TPU smoke test for the Pallas flash-attention kernels.
+
+Runs the compiled (non-interpret) kernels on the local chip and checks
+forward/backward against the jnp reference, then prints timings. The pytest
+suite covers the same kernels in interpreter mode on CPU; this script is the
+on-hardware check (run it plainly: `python tools/flash_smoke.py`).
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from picotron_tpu.ops.attention import sdpa_attention  # noqa: E402
+from picotron_tpu.ops.flash_attention import flash_attention  # noqa: E402
+
+
+def main():
+    print("backend:", jax.default_backend(), jax.devices()[0].device_kind)
+    ks = jax.random.split(jax.random.key(0), 3)
+    b, s, hq, hkv, d = 2, 2048, 16, 4, 64
+    q = jax.random.normal(ks[0], (b, s, hq, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, s, hkv, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, s, hkv, d), jnp.bfloat16)
+
+    f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                                interpret=False))
+    r = jax.jit(lambda q, k, v: sdpa_attention(q, k, v, causal=True))
+    got = jax.block_until_ready(f(q, k, v)).astype(jnp.float32)
+    want = jax.block_until_ready(r(q, k, v)).astype(jnp.float32)
+    print("fwd maxdiff:", float(jnp.abs(got - want).max()))
+
+    def floss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       interpret=False).astype(jnp.float32) ** 2)
+
+    def rloss(q, k, v):
+        return jnp.sum(sdpa_attention(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    gf = jax.jit(jax.grad(floss, (0, 1, 2)))
+    gr = jax.jit(jax.grad(rloss, (0, 1, 2)))
+    a = jax.block_until_ready(gf(q, k, v))
+    b_ = jax.block_until_ready(gr(q, k, v))
+    for x, y, n in zip(a, b_, "qkv"):
+        print(f"d{n} maxdiff:",
+              float(jnp.abs(x.astype(jnp.float32) - y.astype(jnp.float32)).max()))
+
+    def timeit(fn, n=20):
+        jax.block_until_ready(fn(q, k, v))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(q, k, v)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / n * 1e3
+
+    print(f"flash fwd {timeit(f):.2f}ms  sdpa fwd {timeit(r):.2f}ms")
+    print(f"flash fwd+bwd {timeit(gf):.2f}ms  sdpa fwd+bwd {timeit(gr):.2f}ms")
+
+
+if __name__ == "__main__":
+    main()
