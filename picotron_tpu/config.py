@@ -164,6 +164,11 @@ class ModelConfig:
         return self.hidden_size // self.num_attention_heads
 
     def validate(self) -> None:
+        if self.attn_impl not in ("auto", "flash", "reference", "ring"):
+            raise ValueError(
+                f"attn_impl must be one of auto/flash/reference/ring, got "
+                f"{self.attn_impl!r}"
+            )
         if self.hidden_size % self.num_attention_heads != 0:
             raise ValueError("hidden_size must be divisible by num_attention_heads")
         if self.num_attention_heads % self.num_key_value_heads != 0:
@@ -214,6 +219,10 @@ class CheckpointConfig:
     save_dir: str = "ckpt"
     save_frequency: int = 0  # 0 disables periodic saving
     load_path: str = ""
+    # Optional HF safetensors dir to materialize initial weights from (the
+    # reference's bootstrap reads safetensors but only as shape templates,
+    # ref: checkpoint.py:93-101; we actually load the values).
+    init_from_hf: str = ""
 
 
 @dataclass(frozen=True)
@@ -274,6 +283,19 @@ class Config:
         if d.pp_size > m.num_hidden_layers:
             raise ValueError(
                 f"pp_size ({d.pp_size}) cannot exceed num_hidden_layers ({m.num_hidden_layers})"
+            )
+        if m.num_hidden_layers % d.pp_size != 0:
+            # The stacked-layer pp sharding needs an even stage split (the
+            # reference instead pushes the remainder to early stages,
+            # ref: pipeline_parallel.py:42-51).
+            raise ValueError(
+                f"num_hidden_layers ({m.num_hidden_layers}) must be divisible "
+                f"by pp_size ({d.pp_size})"
+            )
+        if t.gradient_accumulation_steps < 1:
+            raise ValueError(
+                f"gradient_accumulation_steps must be >= 1, got "
+                f"{t.gradient_accumulation_steps}"
             )
 
     def to_json_dict(self) -> dict[str, Any]:

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 
 from picotron_tpu.config import ModelConfig
 from picotron_tpu.ops.attention import sdpa_attention
-from picotron_tpu.ops.losses import cross_entropy
+from picotron_tpu.ops.losses import cross_entropy, cross_entropy_sum_count
 from picotron_tpu.ops.rmsnorm import rms_norm
 from picotron_tpu.ops.rope import apply_rope, rope_tables
 
@@ -75,7 +75,8 @@ class ParallelCtx:
     g: Callable = _identity
     # embedding lookup (vocab-parallel TP overrides this)
     embed_lookup: Optional[Callable] = None
-    # fused head+CE (vocab-parallel TP overrides to avoid full-logit gather)
+    # fused head+CE returning (nll_sum, valid_count) (vocab-parallel TP
+    # overrides to avoid full-logit gather)
     head_ce: Optional[Callable] = None
     # logits gather for eval under TP
     gather_logits: Callable = _identity
@@ -146,7 +147,8 @@ def param_count(params: Params) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _compute_dtype(cfg: ModelConfig):
+def compute_dtype(cfg: ModelConfig):
+    """Activation/compute dtype for this model config (params stay fp32)."""
     return jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
 
 
@@ -158,7 +160,7 @@ def embed(params: Params, input_ids: jnp.ndarray, cfg: ModelConfig,
         x = ctx.embed_lookup(w, input_ids)
     else:
         x = w[input_ids]
-    return x.astype(_compute_dtype(cfg))
+    return x.astype(compute_dtype(cfg))
 
 
 def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
@@ -251,11 +253,14 @@ def forward(params: Params, input_ids: jnp.ndarray, cfg: ModelConfig,
     return logits_from_hidden(params, x, cfg, ctx)
 
 
-def loss_fn(params: Params, input_ids: jnp.ndarray, targets: jnp.ndarray,
-            cfg: ModelConfig, ctx: ParallelCtx = DEFAULT_CTX) -> jnp.ndarray:
-    """Token-mean cross-entropy training loss (ref: train.py:43-49).
+def loss_sum_count(params: Params, input_ids: jnp.ndarray, targets: jnp.ndarray,
+                   cfg: ModelConfig, ctx: ParallelCtx = DEFAULT_CTX):
+    """(sum of per-token NLL, valid-token count) — the reduction pieces, so
+    data-parallel shards can psum both and divide once (a per-shard mean +
+    unweighted pmean would mis-weight shards with different IGNORE_INDEX
+    counts).
 
-    Under TP, `ctx.head_ce` computes the loss against vocab-sharded logits
+    Under TP, `ctx.head_ce` computes the pieces against vocab-sharded logits
     without materializing the full-vocab gather.
     """
     cos, sin = rope_tables(cfg.max_position_embeddings, cfg.head_dim, cfg.rope_theta)
@@ -265,4 +270,11 @@ def loss_fn(params: Params, input_ids: jnp.ndarray, targets: jnp.ndarray,
     if ctx.head_ce is not None:
         return ctx.head_ce(x, params["lm_head"], targets)
     logits = x @ params["lm_head"].astype(x.dtype)
-    return cross_entropy(logits, targets)
+    return cross_entropy_sum_count(logits, targets)
+
+
+def loss_fn(params: Params, input_ids: jnp.ndarray, targets: jnp.ndarray,
+            cfg: ModelConfig, ctx: ParallelCtx = DEFAULT_CTX) -> jnp.ndarray:
+    """Token-mean cross-entropy training loss (ref: train.py:43-49)."""
+    total, count = loss_sum_count(params, input_ids, targets, cfg, ctx)
+    return total / jnp.maximum(count, 1)

@@ -8,3 +8,6 @@ jnp RMSNorm (XLA fuses it), fused rotary -> jnp rotary fused by XLA.
 from picotron_tpu.ops.rope import rope_tables, apply_rope  # noqa: F401
 from picotron_tpu.ops.rmsnorm import rms_norm  # noqa: F401
 from picotron_tpu.ops.attention import sdpa_attention  # noqa: F401
+from picotron_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from picotron_tpu.ops.ring_attention import ring_attention  # noqa: F401
+from picotron_tpu.ops.losses import cross_entropy, cross_entropy_sum_count  # noqa: F401

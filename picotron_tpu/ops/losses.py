@@ -18,11 +18,14 @@ import jax.numpy as jnp
 IGNORE_INDEX = -100
 
 
-def cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
-    """Token-mean cross entropy.
+def cross_entropy_sum_count(logits: jnp.ndarray, targets: jnp.ndarray):
+    """(sum of per-token NLL, number of non-ignored tokens) — the reduction
+    pieces, so data-parallel shards can psum both and divide once (a per-shard
+    mean followed by an unweighted pmean would mis-weight shards whose
+    IGNORE_INDEX counts differ).
 
     logits: [..., vocab] (any float dtype; upcast to fp32)
-    targets: [...] int labels, IGNORE_INDEX entries excluded from the mean.
+    targets: [...] int labels, IGNORE_INDEX entries excluded.
     """
     logits = logits.astype(jnp.float32)
     valid = targets != IGNORE_INDEX
@@ -32,5 +35,10 @@ def cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
         logits, safe_targets[..., None], axis=-1
     ).squeeze(-1)
     nll = jnp.where(valid, logz - label_logit, 0.0)
-    count = jnp.maximum(jnp.sum(valid), 1)
-    return jnp.sum(nll) / count
+    return jnp.sum(nll), jnp.sum(valid)
+
+
+def cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Token-mean cross entropy over the non-ignored tokens."""
+    total, count = cross_entropy_sum_count(logits, targets)
+    return total / jnp.maximum(count, 1)

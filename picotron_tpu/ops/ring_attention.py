@@ -1,0 +1,133 @@
+"""Ring attention: context-parallel causal attention over a 'cp' mesh axis.
+
+TPU-native equivalent of the reference's ring attention
+(ref: picotron/context_parallel/context_parallel.py:17-110 +
+cp_communications.py): K/V blocks rotate around the cp ring while each device
+computes blockwise attention of its local queries against the visiting block,
+merging partial results with online-softmax LSE updates
+(ref: context_parallel.py:157-187).
+
+Design differences from the reference, all deliberate:
+
+- **`lax.ppermute` instead of batched isend/irecv.** The ring neighbors the
+  reference derives from its process-group singleton
+  (ref: process_group_manager.py:43-44) are just the cp axis ordering; XLA
+  lowers the ppermute to an ICI collective-permute and its latency-hiding
+  scheduler overlaps it with the blockwise attention compute — the manual
+  comm/compute overlap the reference codes by hand (ref:
+  context_parallel.py:30-45).
+- **No custom backward.** The reference hand-writes a 110-line autograd
+  Function whose backward runs a second ring for dK/dV accumulators (ref:
+  context_parallel.py:54-110) because torch cannot differentiate through its
+  P2P calls. JAX transposes `ppermute` natively (the transpose is the inverse
+  permutation), so reverse-mode AD derives exactly that dK/dV ring for free.
+- **GQA-aware**: the unexpanded K/V heads travel the ring (smaller transfers);
+  head expansion happens inside the blockwise kernel.
+- **Positions are explicit.** Causality across blocks is decided by global
+  token positions, so the same code is correct for any sequence layout.
+  The default layout is the reference's contiguous split
+  (ref: data.py:105-109), whose known causal load imbalance
+  (SURVEY.md §3.4) is inherent to the layout, not to this kernel.
+
+Full-compute note: every device computes every visiting block, with fully
+masked (future) blocks contributing zero via lse = -inf. The reference skips
+those blocks per-rank (`step <= rank`, ref: context_parallel.py:36), but under
+SPMD a data-dependent skip would still execute as a select on TPU; the real
+fix for the causal imbalance is zigzag ordering, which changes `positions`,
+not this function.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from picotron_tpu.ops.attention import sdpa_attention
+
+
+def _merge(out_acc, lse_acc, out_blk, lse_blk):
+    """Online-softmax merge of two partial attention results.
+
+    out: [B, S, H, D] fp32, lse: [B, H, S] fp32 (-inf where no keys attended).
+    Numerically-stable log-space merge — same role as the reference's
+    sigmoid/logsigmoid update (ref: context_parallel.py:157-187).
+    """
+    m = jnp.maximum(lse_acc, lse_blk)
+    # Guard fully-masked rows (m = -inf): exp(-inf - -inf) would be NaN.
+    m_safe = jnp.where(jnp.isinf(m) & (m < 0), 0.0, m)
+    w_acc = jnp.exp(lse_acc - m_safe)  # 0 where lse_acc = -inf
+    w_blk = jnp.exp(lse_blk - m_safe)
+    denom = w_acc + w_blk
+    denom_safe = jnp.where(denom == 0.0, 1.0, denom)
+    # Renormalize so out stays the *normalized* attention over every block
+    # seen so far (invariant: out = sum_i out_i * exp(lse_i - lse_total)).
+    wa = w_acc / denom_safe
+    wb = w_blk / denom_safe
+    # [B, H, S] -> [B, S, H, 1] to weight the outputs
+    out = (out_acc * jnp.transpose(wa, (0, 2, 1))[..., None]
+           + out_blk * jnp.transpose(wb, (0, 2, 1))[..., None])
+    lse = m_safe + jnp.log(denom_safe)
+    lse = jnp.where(denom == 0.0, -jnp.inf, lse)
+    return out, lse
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis: str = "cp",
+    q_positions: jnp.ndarray | None = None,
+    attn_block=None,
+) -> jnp.ndarray:
+    """Causal ring attention over the named mesh axis `axis`.
+
+    Must be called inside shard_map with `axis` in scope. Each device holds
+    the contiguous sequence shard of its cp index:
+
+      q:    [B, S_local, Hq, D]
+      k, v: [B, S_local, Hkv, D]   (Hkv <= Hq, GQA unexpanded)
+
+    q_positions: optional [S_local] global positions of the local tokens;
+        defaults to the contiguous layout `cp_index * S_local + arange`.
+    attn_block: blockwise attention implementation with the signature of
+        `sdpa_attention(..., return_lse=True)`; defaults to the jnp reference
+        path (the Pallas flash kernel slots in here).
+
+    Returns [B, S_local, Hq, D] in q.dtype.
+    """
+    n = lax.psum(1, axis)  # static axis size
+    s_local = q.shape[1]
+    my = lax.axis_index(axis)
+    if q_positions is None:
+        q_positions = my * s_local + jnp.arange(s_local)
+    if attn_block is None:
+        attn_block = partial(sdpa_attention, return_lse=True)
+
+    b, _, h, d = q.shape
+    out_acc = jnp.zeros((b, s_local, h, d), jnp.float32)
+    lse_acc = jnp.full((b, h, s_local), -jnp.inf, jnp.float32)
+
+    # Send K/V to the next cp index, receive from the previous — after step t
+    # this device holds the block originating at cp index (my - t) mod n
+    # (ref: cp_communications.py:22-36 builds the same ring).
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+
+    for step in range(n):
+        src = (my - step) % n
+        kv_positions = src * s_local + jnp.arange(s_local)
+        out_blk, lse_blk = attn_block(
+            q, k, v,
+            causal=True,
+            q_positions=q_positions,
+            kv_positions=kv_positions,
+        )
+        out_acc, lse_acc = _merge(out_acc, lse_acc,
+                                  out_blk.astype(jnp.float32), lse_blk)
+        if step != n - 1:
+            k = lax.ppermute(k, axis, fwd_perm)
+            v = lax.ppermute(v, axis, fwd_perm)
+
+    return out_acc.astype(q.dtype)

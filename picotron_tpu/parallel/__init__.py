@@ -16,4 +16,9 @@ plus hand-written autograd collectives (SURVEY.md §2 rows 4-11), here:
 """
 
 from picotron_tpu.parallel.sharding import param_specs, batch_spec  # noqa: F401
-from picotron_tpu.parallel.api import make_train_step, make_parallel_ctx  # noqa: F401
+from picotron_tpu.parallel.api import (  # noqa: F401
+    init_sharded_state,
+    make_parallel_ctx,
+    make_train_step,
+)
+from picotron_tpu.parallel.pp import pipeline_loss_sum_count  # noqa: F401

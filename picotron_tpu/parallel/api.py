@@ -21,7 +21,6 @@ DataParallelBucket -> train_step dispatch, ref: train.py:174-231):
 from __future__ import annotations
 
 from functools import partial
-from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -31,12 +30,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from picotron_tpu.config import Config
 from picotron_tpu.mesh import MeshEnv
-from picotron_tpu.models.llama import ParallelCtx, init_params, loss_fn
+from picotron_tpu.models.llama import ParallelCtx, init_params, loss_sum_count
 from picotron_tpu.optimizer import make_optimizer
-from picotron_tpu.parallel.sharding import batch_spec, param_specs
+from picotron_tpu.parallel.sharding import batch_spec, param_shardings, param_specs
 from picotron_tpu.parallel.tp import (
     gather_logits,
-    vocab_parallel_ce,
+    vocab_parallel_ce_sum_count,
     vocab_parallel_embed,
 )
 from picotron_tpu.train_step import TrainState
@@ -53,23 +52,42 @@ def make_parallel_ctx(cfg: Config) -> ParallelCtx:
     s_local = cfg.training.seq_length // d.cp_size
     positions = lax.axis_index("cp") * s_local + jnp.arange(s_local)
 
+    # Attention implementation dispatch (the reference routes via the
+    # FLASH_ATTEN / CONTEXT_PARALLEL env vars, ref: model.py:148-158):
+    # flash = the Pallas kernel on TPU (jnp twin elsewhere), reference = the
+    # plain jnp softmax path, ring = require context parallelism.
+    if cfg.model.attn_impl == "ring" and d.cp_size == 1:
+        raise ValueError(
+            "attn_impl='ring' requires cp_size > 1 (ring attention is the "
+            "context-parallel schedule; ref: context_parallel.py:10-12)"
+        )
+    if cfg.model.attn_impl in ("auto", "flash", "ring"):
+        from picotron_tpu.ops.flash_attention import flash_attention as attn_fn
+    else:
+        from picotron_tpu.ops.attention import sdpa_attention as attn_fn
+
     if d.cp_size > 1:
         from picotron_tpu.ops.ring_attention import ring_attention
 
-        def attn(q, k, v, pos):
-            return ring_attention(q, k, v, axis="cp")
-    else:
-        from picotron_tpu.ops.attention import sdpa_attention
+        blockwise = partial(attn_fn, return_lse=True)
 
         def attn(q, k, v, pos):
-            return sdpa_attention(q, k, v, causal=True,
-                                  q_positions=pos, kv_positions=pos)
+            # positions are single-sourced here: RoPE and the ring's causal
+            # masking must see the same sequence layout (zigzag ordering, when
+            # it lands, changes `positions` in exactly one place).
+            return ring_attention(q, k, v, axis="cp", q_positions=pos,
+                                  attn_block=blockwise)
+    else:
+
+        def attn(q, k, v, pos):
+            return attn_fn(q, k, v, causal=True,
+                           q_positions=pos, kv_positions=pos)
 
     return ParallelCtx(
         attn=attn,
         g=lambda x: lax.psum(x, "tp"),
         embed_lookup=partial(vocab_parallel_embed, axis="tp"),
-        head_ce=partial(vocab_parallel_ce, axis="tp"),
+        head_ce=partial(vocab_parallel_ce_sum_count, axis="tp"),
         gather_logits=partial(gather_logits, axis="tp"),
         positions=positions,
         remat=cfg.training.remat,
@@ -78,32 +96,59 @@ def make_parallel_ctx(cfg: Config) -> ParallelCtx:
 
 def _device_grads(params, batch, cfg: Config):
     """Per-device grad computation: scan microbatches accumulating fp32
-    grads (ref: train.py:29-55 loop + require_backward_grad_sync gating),
-    then one pmean over the data axes."""
+    NLL-sum grads and valid-token counts (ref: train.py:29-55 loop +
+    require_backward_grad_sync gating), then one psum over the data axes and
+    a single division — a per-shard token mean followed by an unweighted
+    pmean would mis-weight shards whose IGNORE_INDEX counts differ."""
     ctx = make_parallel_ctx(cfg)
     ids, tgt = batch  # [n_micro, mbs_local, s_local]
-    n_micro = ids.shape[0]
+
+    if cfg.distributed.pp_size > 1:
+        # The pipeline scan subsumes the microbatch loop: grad accumulation
+        # across microbatches IS the schedule (ref: train.py:225-227
+        # dispatches to the pipeline schedules the same way).
+        from picotron_tpu.parallel.pp import (
+            pipeline_loss_sum_count, sync_pp_replicated_grads,
+        )
+
+        def pp_nll(params):
+            total, count = pipeline_loss_sum_count(params, ids, tgt, cfg, ctx)
+            return total, count
+
+        (nll_total, count), grads = jax.value_and_grad(pp_nll, has_aux=True)(params)
+        grads = sync_pp_replicated_grads(grads, param_specs(cfg))
+        grads = lax.psum(grads, ("dp", "cp"))
+        nll_total = lax.psum(nll_total, ("dp", "cp"))
+        count = jnp.maximum(lax.psum(count, ("dp", "cp")), 1)
+        return jax.tree.map(lambda g: g / count, grads), nll_total / count
+
+    def nll_sum(params, mb_ids, mb_tgt):
+        total, count = loss_sum_count(params, mb_ids, mb_tgt, cfg.model, ctx)
+        return total, count
 
     def micro_step(carry, mb):
-        g_acc, l_acc = carry
+        g_acc, l_acc, c_acc = carry
         mb_ids, mb_tgt = mb
-        loss, grads = jax.value_and_grad(loss_fn)(params, mb_ids, mb_tgt,
-                                                  cfg.model, ctx)
-        return (jax.tree.map(jnp.add, g_acc, grads), l_acc + loss), None
+        (total, count), grads = jax.value_and_grad(nll_sum, has_aux=True)(
+            params, mb_ids, mb_tgt)
+        return (jax.tree.map(jnp.add, g_acc, grads), l_acc + total,
+                c_acc + count), None
 
-    # The grad/loss accumulators become dp/cp-varying inside the scan (the
-    # loss depends on this device's batch shard), so the initial carry must
-    # carry the same varying type.
+    # The accumulators become dp/cp-varying inside the scan (they depend on
+    # this device's batch shard), so the initial carry must carry the same
+    # varying type.
     zeros = jax.tree.map(jnp.zeros_like, params)
-    init_carry = lax.pcast((zeros, jnp.zeros((), jnp.float32)),
-                           ("dp", "cp"), to="varying")
-    (grads, loss_sum), _ = lax.scan(micro_step, init_carry, (ids, tgt))
-    scale = 1.0 / n_micro
-    grads = jax.tree.map(lambda g: g * scale, grads)
+    init_carry = lax.pcast(
+        (zeros, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        ("dp", "cp"), to="varying")
+    (grads, nll_total, count), _ = lax.scan(micro_step, init_carry, (ids, tgt))
     # gradient + loss sync over the fused data axes (the reference's cp_dp
     # group semantics: ref process_group_manager.py:22, utils.py:93-98)
-    grads = lax.pmean(grads, ("dp", "cp"))
-    loss = lax.pmean(loss_sum * scale, ("dp", "cp"))
+    grads = lax.psum(grads, ("dp", "cp"))
+    nll_total = lax.psum(nll_total, ("dp", "cp"))
+    count = jnp.maximum(lax.psum(count, ("dp", "cp")), 1)
+    grads = jax.tree.map(lambda g: g / count, grads)
+    loss = nll_total / count
     return grads, loss
 
 
@@ -141,14 +186,33 @@ def init_sharded_state(cfg: Config, menv: MeshEnv, key: jax.Array) -> TrainState
     safetensors shape-template dance)."""
     cfg.validate()
     mesh = menv.mesh
-    shardings = jax.tree.map(
-        lambda s: NamedSharding(mesh, s), param_specs(cfg),
-        is_leaf=lambda x: isinstance(x, P),
-    )
+    shardings = param_shardings(cfg, mesh)
     params = jax.jit(
         partial(init_params, cfg.model), out_shardings=shardings
     )(key)
     opt = make_optimizer(cfg.training)
-    opt_state = jax.jit(opt.init)(params)
-    step0 = jnp.zeros((), jnp.int32)
+    # Optimizer moments must mirror the param shardings (Adam mu/nu live
+    # wherever their param lives — the reference gets this implicitly from
+    # per-rank optimizer instances, ref: train.py:209); scalar counters are
+    # replicated. Without explicit out_shardings, jit can leave the whole
+    # opt state on one device, which breaks the first step after a
+    # checkpoint restore. Moment subtrees are recognized structurally (any
+    # opt-state subtree with the params' treedef takes the params'
+    # shardings leaf-for-leaf) — matching by leaf shape would collide for
+    # same-shape/different-spec params like q [h, h] and o [h, h].
+    replicated = NamedSharding(mesh, P())
+    params_treedef = jax.tree.structure(params)
+    param_leaf_shardings = [p.sharding for p in jax.tree.leaves(params)]
+
+    def opt_subtree_shardings(subtree):
+        if jax.tree.structure(subtree) == params_treedef:
+            return jax.tree.unflatten(params_treedef, param_leaf_shardings)
+        return jax.tree.map(lambda _: replicated, subtree)
+
+    abstract_opt = jax.eval_shape(opt.init, params)
+    opt_shardings = jax.tree.map(
+        opt_subtree_shardings, abstract_opt,
+        is_leaf=lambda x: jax.tree.structure(x) == params_treedef)
+    opt_state = jax.jit(opt.init, out_shardings=opt_shardings)(params)
+    step0 = jax.device_put(jnp.zeros((), jnp.int32), replicated)
     return TrainState(params=params, opt_state=opt_state, step=step0)

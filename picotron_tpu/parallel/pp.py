@@ -1,0 +1,153 @@
+"""Pipeline parallelism: microbatch pipelining over the 'pp' mesh axis.
+
+TPU-native equivalent of the reference's pipeline stack
+(ref: picotron/pipeline_parallel/pipeline_parallel.py +
+pp_communications.py). The mapping:
+
+- **Stage slicing** — the reference keeps a contiguous block of decoder
+  layers per stage, embedding on the first stage, norm+head on the last
+  (ref: pipeline_parallel.py:13-51). Here the stacked layer pytree is
+  *sharded* over 'pp' on its leading layer axis (parallel/sharding.py), so
+  inside shard_map each device's `params['layers']` IS its stage slice; the
+  even `distribute_layers` split (ref: pipeline_parallel.py:42-51) is the
+  sharding rule (layers % pp == 0 enforced at config validation).
+- **Activation transport** — the reference's batched isend/irecv pairs with
+  hard cuda synchronization and `CUDA_DEVICE_MAX_CONNECTIONS=1` ordering
+  (ref: pp_communications.py:8-46, base_job.slurm:53) become one
+  `lax.ppermute` per pipeline tick; XLA orders and overlaps it.
+- **Schedule** — one `lax.scan` over `n_micro + pp - 1` ticks. At tick t,
+  stage s processes microbatch `t - s`: stage 0 ingests embedded microbatch
+  t, every stage runs its layer block, the last stage accumulates a masked
+  loss, activations rotate one stage forward. Differentiating through the
+  scan yields the reverse schedule with transposed ppermutes — the manual
+  `torch.autograd.backward` choreography + grad send/recv of the reference
+  (ref: pipeline_parallel.py:65-75, 94-118) is derived, not written.
+- **Grad-sync deferral** — `require_backward_grad_sync` gating on the last
+  microbatch (ref: pipeline_parallel.py:179-199) falls out of psum-ing once,
+  after the scan (see parallel/api.py).
+
+Schedule semantics per engine (ref: train.py:225-227 dispatch):
+- "afab": exactly this scan — all forwards then all backwards, activations
+  retained per tick (the reference's AFAB stores input/output per microbatch,
+  ref: pipeline_parallel.py:94-118; the scan carry plays that role).
+- "1f1b": currently runs the same scan. True 1F1B's only delta is peak
+  activation memory (<= pp in-flight microbatches instead of n_micro);
+  with per-tick rematerialization the scan already bounds stored state to
+  one carry per tick. An explicit interleaved-vjp schedule is planned.
+
+SPMD uniformity note: every stage traces the same program, so embed and the
+loss head are *computed* on every stage and masked where inapplicable. The
+head matmul is the only nontrivial overhead; under TP it is vocab-sharded
+(tp.vocab_parallel_ce_sum_count), which divides that waste by tp_size.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from picotron_tpu.config import Config
+from picotron_tpu.models.llama import (
+    ParallelCtx, compute_dtype, embed, final_hidden, run_layers,
+)
+from picotron_tpu.ops.losses import cross_entropy_sum_count
+from picotron_tpu.ops.rope import rope_tables
+
+
+def pipeline_loss_sum_count(params, ids, tgt, cfg: Config, ctx: ParallelCtx):
+    """(nll_sum, valid_count) for the full microbatch stream, pipelined over
+    'pp'. Must run inside shard_map with 'pp' (and 'dp','cp','tp') in scope.
+
+    ids/tgt: [n_micro, mbs_local, s_local] (this device's dp/cp shard,
+    replicated over pp — every stage sees the token stream; stage 0 reads
+    ids, the last stage reads tgt, matching the reference's dataloader
+    feeding all ranks, ref: pipeline_parallel.py:145-155).
+
+    Outputs are replicated over 'pp' (psum-broadcast from the last stage).
+    """
+    m = cfg.model
+    pp = lax.psum(1, "pp")
+    s_idx = lax.axis_index("pp")
+    n_micro, mbs, s_local = ids.shape
+    n_ticks = n_micro + pp - 1
+
+    cos, sin = rope_tables(m.max_position_embeddings, m.head_dim, m.rope_theta)
+    dtype = compute_dtype(m)
+
+    # Pad the ingest stream to n_ticks; shift the target stream so that at
+    # tick t the last stage scores the microbatch it is finishing (t-(pp-1)).
+    ids_p = jnp.pad(ids, ((0, pp - 1), (0, 0), (0, 0)))
+    tgt_p = jnp.pad(tgt, ((pp - 1, 0), (0, 0), (0, 0)))
+    ticks = jnp.arange(n_ticks)
+    in_valid = ticks < n_micro
+    out_valid = ticks >= pp - 1
+
+    fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+
+    def tick(carry, xs):
+        x_buf, nll_acc, cnt_acc = carry
+        mb_ids, mb_tgt, v_in, v_out = xs
+
+        # Stage 0 ingests a fresh microbatch; others take the rotated-in
+        # activations. Zero-mask padded ingest ticks so garbage never enters
+        # the pipe (it would reach the last stage as a masked tick anyway,
+        # but non-finite values would poison grads through the mask).
+        x0 = embed(params, mb_ids, m, ctx) * v_in.astype(dtype)
+        x_in = jnp.where(s_idx == 0, x0, x_buf)
+
+        y = run_layers(params["layers"], x_in, m, ctx, cos, sin)
+
+        # Last stage: norm + head + CE on the microbatch leaving the pipe.
+        hf = final_hidden(params, y, m)
+        if ctx.head_ce is not None:
+            total, count = ctx.head_ce(hf, params["lm_head"], mb_tgt)
+        else:
+            logits = hf @ params["lm_head"].astype(hf.dtype)
+            total, count = cross_entropy_sum_count(logits, mb_tgt)
+        take = (s_idx == pp - 1) & v_out
+        nll_acc = nll_acc + jnp.where(take, total, 0.0)
+        cnt_acc = cnt_acc + jnp.where(take, count, 0)
+
+        y_next = lax.ppermute(y, "pp", fwd_perm)
+        return (y_next, nll_acc, cnt_acc), None
+
+    x0_buf = jnp.zeros((mbs, s_local, m.hidden_size), dtype)
+    init = lax.pcast(
+        (x0_buf, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        ("dp", "cp", "pp"), to="varying")
+    body = tick
+    if ctx.remat:
+        body = jax.checkpoint(body)
+    (x_last, nll_sum, cnt), _ = lax.scan(
+        body, init, (ids_p, tgt_p, in_valid, out_valid))
+
+    # Broadcast the last stage's totals to every stage (masked elsewhere, so
+    # psum == select; ref: utils.py:93-98 averages loss on the last PP stage
+    # then broadcasts via the wandb-rank convention).
+    nll_sum = lax.psum(nll_sum, "pp")
+    cnt = lax.psum(cnt, "pp")
+    return nll_sum, cnt
+
+
+def sync_pp_replicated_grads(grads, specs):
+    """psum over 'pp' the grads of params replicated across pipeline stages
+    (embedding / final norm / lm_head): each is used by one stage, so its
+    per-stage grads are disjoint and the sum assembles the true total.
+    Layer params are sharded over 'pp' (leading axis) and need no collective.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def fix(g, spec):
+        flat = []
+        for part in spec:
+            if isinstance(part, (tuple, list)):
+                flat.extend(part)
+            elif part is not None:
+                flat.append(part)
+        if "pp" in flat:
+            return g
+        return lax.psum(g, "pp")
+
+    return jax.tree.map(fix, grads, specs,
+                        is_leaf=lambda x: isinstance(x, P))

@@ -30,13 +30,8 @@ from picotron_tpu.config import Config
 
 def param_specs(cfg: Config) -> dict[str, Any]:
     """PartitionSpec pytree matching models.llama.init_params' structure."""
+    # layers % pp divisibility is enforced by Config.validate().
     pp = "pp" if cfg.distributed.pp_size > 1 else None
-    if cfg.distributed.pp_size > 1:
-        if cfg.model.num_hidden_layers % cfg.distributed.pp_size != 0:
-            raise ValueError(
-                "num_hidden_layers must be divisible by pp_size (stacked stage "
-                f"sharding): {cfg.model.num_hidden_layers} % {cfg.distributed.pp_size}"
-            )
     return {
         "embedding": P("tp", None),
         "layers": {

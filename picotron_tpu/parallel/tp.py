@@ -49,13 +49,14 @@ def vocab_parallel_embed(w_shard: jnp.ndarray, ids: jnp.ndarray,
     return lax.psum(x, axis)
 
 
-def vocab_parallel_ce(hidden: jnp.ndarray, head_shard: jnp.ndarray,
-                      targets: jnp.ndarray, axis: str = "tp") -> jnp.ndarray:
-    """Token-mean cross-entropy against a vocab-sharded LM head.
+def vocab_parallel_ce_sum_count(hidden: jnp.ndarray, head_shard: jnp.ndarray,
+                                targets: jnp.ndarray, axis: str = "tp"):
+    """(sum of per-token NLL, valid-token count) against a vocab-sharded LM
+    head — the reduction pieces, so dp/cp shards can psum both and divide once.
 
     hidden: [B, S, H] (replicated over tp); head_shard: [H, vocab/tp];
-    targets: [B, S] with IGNORE_INDEX allowed. Returns a scalar replicated
-    over tp. Matches ops.losses.cross_entropy numerically.
+    targets: [B, S] with IGNORE_INDEX allowed. Both outputs are replicated
+    over tp. Matches ops.losses.cross_entropy_sum_count numerically.
     """
     logits = (hidden @ head_shard.astype(hidden.dtype)).astype(jnp.float32)
     vshard = logits.shape[-1]
@@ -76,8 +77,14 @@ def vocab_parallel_ce(hidden: jnp.ndarray, head_shard: jnp.ndarray,
     label_logit = lax.psum(local_label * ok.astype(jnp.float32), axis)
 
     nll = jnp.where(valid, logz - label_logit, 0.0)
-    count = jnp.maximum(jnp.sum(valid), 1)
-    return jnp.sum(nll) / count
+    return jnp.sum(nll), jnp.sum(valid)
+
+
+def vocab_parallel_ce(hidden: jnp.ndarray, head_shard: jnp.ndarray,
+                      targets: jnp.ndarray, axis: str = "tp") -> jnp.ndarray:
+    """Token-mean cross-entropy against a vocab-sharded LM head."""
+    total, count = vocab_parallel_ce_sum_count(hidden, head_shard, targets, axis)
+    return total / jnp.maximum(count, 1)
 
 
 def gather_logits(logits: jnp.ndarray, axis: str = "tp") -> jnp.ndarray:

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import optax
 
 from picotron_tpu.config import Config
-from picotron_tpu.models.llama import DEFAULT_CTX, ParallelCtx, loss_fn
+from picotron_tpu.models.llama import DEFAULT_CTX, ParallelCtx, loss_sum_count
 from picotron_tpu.optimizer import make_optimizer
 
 
@@ -39,22 +39,29 @@ def accumulate_grads(params, batch, cfg: Config, ctx: ParallelCtx):
 
     batch: (input_ids, targets), each [n_micro, mbs, seq].
     """
-    n_micro = batch[0].shape[0]
+    def nll(params, ids, tgt):
+        return loss_sum_count(params, ids, tgt, cfg.model, ctx)
 
     def micro_step(carry, mb):
-        grads_acc, loss_acc = carry
+        grads_acc, loss_acc, count_acc = carry
         ids, tgt = mb
-        loss, grads = jax.value_and_grad(loss_fn)(params, ids, tgt, cfg.model, ctx)
+        (total, count), grads = jax.value_and_grad(nll, has_aux=True)(
+            params, ids, tgt)
         grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
-        return (grads_acc, loss_acc + loss), None
+        return (grads_acc, loss_acc + total, count_acc + count), None
 
     zero_grads = jax.tree.map(jnp.zeros_like, params)
-    (grads, loss_sum), _ = jax.lax.scan(
-        micro_step, (zero_grads, jnp.zeros((), jnp.float32)), batch
+    (grads, nll_total, count), _ = jax.lax.scan(
+        micro_step,
+        (zero_grads, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        batch,
     )
-    scale = 1.0 / n_micro
-    grads = jax.tree.map(lambda g: g * scale, grads)
-    return grads, loss_sum * scale
+    # Global token mean: sum of NLL over all microbatches / total valid
+    # tokens — same reduction as the parallel path (parallel/api.py), so a
+    # dp=1 run matches this baseline even with uneven IGNORE_INDEX counts.
+    count = jnp.maximum(count, 1)
+    grads = jax.tree.map(lambda g: g / count, grads)
+    return grads, nll_total / count
 
 
 def make_train_step(cfg: Config, ctx: ParallelCtx = DEFAULT_CTX):

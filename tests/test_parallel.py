@@ -19,13 +19,14 @@ from picotron_tpu.train_step import init_train_state, make_train_step as make_si
 
 
 def tiny_cfg(**dist) -> Config:
+    gas = dist.pop("gas", 2)
     return Config(
         distributed=DistributedConfig(**dist),
         # 8 q heads / 4 kv heads so GQA survives tp up to 4
         model=ModelConfig(dtype="float32", num_attention_heads=8,
                           num_key_value_heads=4),
         training=TrainingConfig(seq_length=32, micro_batch_size=2,
-                                gradient_accumulation_steps=2,
+                                gradient_accumulation_steps=gas,
                                 learning_rate=1e-3, remat=False),
     )
 
@@ -76,6 +77,14 @@ def run_single(cfg_parallel, steps=3):
     dict(tp_size=4),
     dict(dp_size=2, tp_size=2),
     dict(dp_size=2, tp_size=4),
+    dict(cp_size=4),
+    dict(dp_size=2, cp_size=2, tp_size=2),
+    dict(pp_size=2),
+    dict(dp_size=2, pp_size=2),
+    dict(pp_size=2, tp_size=2),
+    dict(pp_size=4, gas=4),
+    dict(dp_size=2, pp_size=2, cp_size=2),
+    dict(dp_size=2, pp_size=2, tp_size=2),
 ])
 def test_layouts_match_single_device(dist):
     cfg = tiny_cfg(**dist)
