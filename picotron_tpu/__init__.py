@@ -17,3 +17,9 @@ __version__ = "0.1.0"
 
 from picotron_tpu.config import Config, load_config  # noqa: F401
 from picotron_tpu.mesh import MeshEnv  # noqa: F401
+from picotron_tpu.data import MicroBatchDataLoader  # noqa: F401
+from picotron_tpu.checkpoint import CheckpointManager  # noqa: F401
+from picotron_tpu.parallel.api import (  # noqa: F401
+    init_sharded_state,
+    make_train_step,
+)

@@ -1,0 +1,190 @@
+"""Data pipeline: tokenized, chunked micro-batch streams as sharded arrays.
+
+Capability parity with the reference's dataloader (ref: picotron/data.py),
+restructured for a single-controller SPMD runtime:
+
+- The reference runs one DataLoader per rank with a `DistributedSampler`
+  sharded by dp_rank (ref: data.py:40-45) and a collate function that slices
+  each sequence to the local cp rank's contiguous chunk (ref: data.py:102-116).
+  Here the host assembles the *global* batch [n_micro, global_batch, seq]
+  and `jax.device_put` with a `P(None, 'dp', 'cp')` sharding hands every
+  device exactly the shard those two mechanisms produced — the dp split on
+  the batch dim, the contiguous cp split on the sequence dim.
+- Tokenizer broadcast via `broadcast_object_list` (ref: data.py:23-32)
+  disappears: one process per host means plain host code.
+- `global_batch_size = mbs * grad_acc * dp` and
+  `seq_length_per_device = seq_len / cp` keep the reference's batch math
+  (ref: data.py:17-20).
+- The reference tokenizes with `dataset.map(..., remove_columns)` grouping
+  text into fixed `seq_len+1` blocks (ref: data.py:57-100); `tokenize_and_chunk`
+  reproduces that contract. A deterministic synthetic stream stands in where
+  the environment has no dataset/network (TPU pods frequently run with zero
+  egress), and is what tests and benchmarks use.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Iterator, Optional
+
+import jax
+import numpy as np
+
+from picotron_tpu.config import Config
+
+
+# ---------------------------------------------------------------------------
+# Tokenize + chunk (ref: data.py:57-100)
+# ---------------------------------------------------------------------------
+
+
+def tokenize_and_chunk(dataset, tokenizer, seq_length: int,
+                       text_column: str = "text", num_proc: int = 1):
+    """Tokenize `text_column`, concatenate, and chunk into fixed
+    `seq_length + 1`-token blocks (one extra token so input/target shifting
+    needs no cross-block state) — the reference's `tokenizer_group_text`
+    pipeline (ref: data.py:57-100).
+
+    Returns a dataset of {"input_ids": [seq_length + 1]} rows.
+    """
+    block = seq_length + 1
+
+    def tok_group(batch):
+        texts = batch[text_column]
+        out = tokenizer(texts)["input_ids"]
+        concat = list(itertools.chain.from_iterable(out))
+        n_blocks = len(concat) // block
+        return {
+            "input_ids": [
+                concat[i * block:(i + 1) * block] for i in range(n_blocks)
+            ]
+        }
+
+    return dataset.map(
+        tok_group,
+        batched=True,
+        remove_columns=dataset.column_names,
+        num_proc=num_proc if num_proc > 1 else None,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Batch sources
+# ---------------------------------------------------------------------------
+
+
+class SyntheticSource:
+    """Deterministic PRNG token blocks — the zero-egress stand-in for a real
+    dataset; same role as the reference's CPU config for cluster-free runs
+    (ref: README.md:40-47)."""
+
+    def __init__(self, vocab_size: int, seq_length: int, seed: int = 0,
+                 num_samples: Optional[int] = None):
+        self.vocab_size = vocab_size
+        self.block = seq_length + 1
+        self.seed = seed
+        # Finite epoch so the infinite-iteration epoch-bump path is exercised
+        # (ref: data.py:118-137); effectively unbounded by default.
+        self.num_samples = num_samples or 1 << 30
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def get_rows(self, epoch: int, start: int, n: int) -> np.ndarray:
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, epoch, start]))
+        return rng.integers(0, self.vocab_size, (n, self.block), dtype=np.int32)
+
+
+class DatasetSource:
+    """Adapter over a chunked HF dataset (rows of {"input_ids": [block]})."""
+
+    def __init__(self, dataset, shuffle_seed: Optional[int] = None):
+        self.dataset = dataset
+        self.shuffle_seed = shuffle_seed
+        self._epoch_cache: tuple[int, Any] | None = None
+
+    def __len__(self) -> int:
+        return len(self.dataset)
+
+    def _epoch_view(self, epoch: int):
+        if self._epoch_cache is not None and self._epoch_cache[0] == epoch:
+            return self._epoch_cache[1]
+        ds = self.dataset
+        if self.shuffle_seed is not None:
+            # New permutation each epoch (the role of DistributedSampler's
+            # set_epoch, ref: data.py:131).
+            ds = ds.shuffle(seed=self.shuffle_seed + epoch)
+        self._epoch_cache = (epoch, ds)
+        return ds
+
+    def get_rows(self, epoch: int, start: int, n: int) -> np.ndarray:
+        ds = self._epoch_view(epoch)
+        rows = ds[start:start + n]["input_ids"]
+        return np.asarray(rows, dtype=np.int32)
+
+
+# ---------------------------------------------------------------------------
+# The loader
+# ---------------------------------------------------------------------------
+
+
+class MicroBatchDataLoader:
+    """Yields (input_ids, targets) pairs shaped
+    [grad_acc, global_batch, seq_length], device_put into the mesh's
+    P(None, 'dp', 'cp') sharding. Iteration is infinite: exhausting the
+    source bumps the epoch and continues (ref: data.py:118-137).
+    """
+
+    def __init__(self, cfg: Config, menv, source=None):
+        self.cfg = cfg
+        self.menv = menv
+        self.global_batch_size = cfg.global_batch_size  # ref: data.py:17
+        self.seq_length = cfg.training.seq_length
+        self.source = source if source is not None else self._build_source()
+        if len(self.source) < self.global_batch_size:
+            raise ValueError(
+                f"dataset has {len(self.source)} blocks < one step's "
+                f"{self.global_batch_size}"
+            )
+        self.epoch = 0
+        self.cursor = 0
+        self.sharding = menv.batch_sharding()
+
+    def _build_source(self):
+        d = self.cfg.dataset
+        if d.name == "synthetic":
+            return SyntheticSource(
+                self.cfg.model.vocab_size, self.seq_length,
+                seed=self.cfg.training.seed,
+                num_samples=self.cfg.training.num_samples,
+            )
+        import datasets  # HF; lazy so synthetic paths never import it
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(
+            d.tokenizer_name or self.cfg.model.name)
+        raw = datasets.load_dataset(d.name, d.subset_name, split=d.split)
+        chunked = tokenize_and_chunk(
+            raw, tokenizer, self.seq_length, d.text_column, d.num_proc)
+        return DatasetSource(chunked, shuffle_seed=self.cfg.training.seed)
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        n = self.global_batch_size
+        if self.cursor + n > len(self.source):
+            self.epoch += 1  # ref: data.py:129-133 epoch bump
+            self.cursor = 0
+        rows = self.source.get_rows(self.epoch, self.cursor, n)
+        self.cursor += n
+        t = self.cfg.training
+        blocks = rows.reshape(
+            t.gradient_accumulation_steps,
+            t.micro_batch_size * self.cfg.distributed.dp_size,
+            self.seq_length + 1,
+        )
+        ids = jax.device_put(blocks[..., :-1], self.sharding)
+        targets = jax.device_put(blocks[..., 1:], self.sharding)
+        return ids, targets

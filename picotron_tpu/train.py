@@ -1,0 +1,131 @@
+"""Training driver — `python -m picotron_tpu.train --config cfg.json`.
+
+Parity with the reference's train.py (ref: train.py:57-281), single-controller:
+load config -> initialize the (possibly multi-host) runtime -> build mesh,
+dataloader, sharded train state (fresh, HF-bootstrapped, or resumed) -> step
+loop with per-step tokens/s / MFU / memory logging -> periodic checkpointing.
+
+What disappears relative to the reference: torchrun rank choreography, the
+rank-0 config/tokenizer broadcasts (ref: train.py:152-165, data.py:23-32),
+device placement flags, and the env-var dispatch channel — one process per
+host runs ordinary Python and every collective lives inside the jitted step.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+
+from picotron_tpu.checkpoint import CheckpointManager, load_hf_safetensors
+from picotron_tpu.config import Config, load_config, num_params
+from picotron_tpu.data import MicroBatchDataLoader
+from picotron_tpu.mesh import MeshEnv, multihost_initialize
+from picotron_tpu.parallel.api import init_sharded_state, make_train_step
+from picotron_tpu.parallel.sharding import param_shardings
+from picotron_tpu.train_step import TrainState
+from picotron_tpu.utils import (
+    StepTimer, device_memory_gb, device_peak_flops, human_format,
+    is_logging_host, log_print, mfu, training_log_line,
+)
+
+
+def build_state(cfg: Config, menv: MeshEnv) -> tuple[TrainState, int, int]:
+    """(state, start_step, trained_tokens) — fresh init, HF weights, or
+    resume, in the reference's precedence (ref: train.py:174-215: materialize
+    weights, then load_checkpoint overrides)."""
+    state = init_sharded_state(cfg, menv, jax.random.key(cfg.training.seed))
+
+    if cfg.checkpoint.init_from_hf:
+        params = load_hf_safetensors(cfg.checkpoint.init_from_hf, cfg.model)
+        shardings = param_shardings(cfg, menv.mesh)
+        params = jax.tree.map(jax.device_put, params, shardings)
+        state = TrainState(params=params, opt_state=state.opt_state,
+                           step=state.step)
+        log_print(f"initialized weights from {cfg.checkpoint.init_from_hf}")
+
+    if cfg.checkpoint.load_path:
+        mgr = CheckpointManager(cfg, menv, directory=cfg.checkpoint.load_path)
+        state, tokens = mgr.restore(state)
+        log_print(f"resumed from {cfg.checkpoint.load_path} at step "
+                  f"{int(state.step)} ({human_format(tokens)} tokens)")
+        return state, int(state.step), tokens
+    return state, 0, 0
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="picotron-tpu trainer")
+    ap.add_argument("--config", required=True, help="config JSON path "
+                    "(reference-schema compatible)")
+    args = ap.parse_args(argv)
+
+    cfg = load_config(args.config)
+    multihost_initialize()
+    menv = MeshEnv.from_config(cfg)
+    t = cfg.training
+
+    n_chips = menv.world_size
+    n_params = num_params(cfg.model)
+    peak = device_peak_flops()
+    log_print(
+        f"model {cfg.model.name}: {human_format(n_params)} params | "
+        f"mesh dp={menv.dp} pp={menv.pp} cp={menv.cp} tp={menv.tp} "
+        f"({n_chips} chips, {jax.devices()[0].device_kind}) | "
+        f"global batch {cfg.global_batch_size} x seq {t.seq_length} = "
+        f"{human_format(cfg.tokens_per_step)} tokens/step"
+    )
+
+    dl = MicroBatchDataLoader(cfg, menv)
+    state, start_step, trained_tokens = build_state(cfg, menv)
+    step_fn = make_train_step(cfg, menv)
+    ckpt_mgr = (CheckpointManager(cfg, menv)
+                if cfg.checkpoint.save_frequency > 0 else None)
+
+    wandb_run = None
+    if cfg.logging.use_wandb and is_logging_host():
+        try:
+            import wandb
+            wandb_run = wandb.init(project=cfg.logging.project_name,
+                                   name=cfg.logging.run_name,
+                                   config=cfg.to_json_dict())
+        except Exception as e:  # wandb optional; zero-egress pods have none
+            log_print(f"wandb unavailable ({e}); continuing without")
+
+    timer = StepTimer()
+    last_logged_step = start_step
+    for step in range(start_step + 1, t.total_train_steps + 1):
+        batch = next(dl)
+        state, loss = step_fn(state, batch)
+        trained_tokens += cfg.tokens_per_step
+
+        if step % cfg.logging.log_frequency == 0 or step == t.total_train_steps:
+            loss = float(jax.block_until_ready(loss))
+            dt = timer.lap()
+            steps_in_window = step - last_logged_step
+            last_logged_step = step
+            tokens_per_sec = cfg.tokens_per_step * steps_in_window / dt
+            mfu_frac = mfu(tokens_per_sec, cfg.model, t.seq_length,
+                           n_chips, peak)
+            line = training_log_line(
+                step, loss, tokens_per_sec, tokens_per_sec / n_chips,
+                mfu_frac, trained_tokens, device_memory_gb())
+            log_print(line)
+            if wandb_run is not None:
+                wandb_run.log({"loss": loss, "tokens_per_sec": tokens_per_sec,
+                               "mfu": mfu_frac,
+                               "trained_tokens": trained_tokens}, step=step)
+
+        if ckpt_mgr is not None and step % cfg.checkpoint.save_frequency == 0:
+            path = ckpt_mgr.save(state, trained_tokens)
+            log_print(f"saved checkpoint -> {path}")
+
+    if ckpt_mgr is not None:
+        ckpt_mgr.save(state, trained_tokens)
+    if wandb_run is not None:
+        wandb_run.finish()
+    log_print("training done")
+
+
+if __name__ == "__main__":
+    main()

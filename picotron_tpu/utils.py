@@ -1,0 +1,154 @@
+"""Shared utilities: seeds, formatting, MFU accounting, rank-aware printing.
+
+Capability parity with the reference's utils (ref: picotron/utils.py), with the
+hardware constants made TPU-native: the reference hardcodes the H100 bf16 peak
+(989.5 TFLOP/s, ref: utils.py:42); here peak FLOP/s is looked up per TPU
+generation from the device kind, as SURVEY.md §5 prescribes.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Optional
+
+import jax
+
+from picotron_tpu.config import Config, ModelConfig, num_params
+
+
+# ---------------------------------------------------------------------------
+# Hardware peaks
+# ---------------------------------------------------------------------------
+
+# Published per-chip bf16 peak FLOP/s by TPU generation.
+TPU_PEAK_FLOPS: dict[str, float] = {
+    "v2": 45e12,
+    "v3": 123e12,
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,  # Trillium
+    "v6p": 918e12,
+}
+# The reference's H100 constant, kept for apples-to-apples MFU comparison
+# against its published numbers (ref: utils.py:42).
+H100_BF16_PEAK = 989.5e12
+
+
+def device_peak_flops(device: Optional[jax.Device] = None) -> float:
+    """Per-chip bf16 peak FLOP/s for `device` (default: first local device).
+
+    Real device_kind strings use the hardware naming, not the marketing one:
+    a v5e reports "TPU v5 lite", a v6e/Trillium "TPU v6 lite", a v5p
+    "TPU v5p" (and "TPU v5" alone means v5p). Unknown kinds (e.g. the CPU
+    test platform) fall back to the v5e peak so derived MFU stays finite and
+    comparable.
+    """
+    if device is None:
+        device = jax.devices()[0]
+    kind = device.device_kind.lower()
+    if "v6" in kind or "trillium" in kind:
+        return TPU_PEAK_FLOPS["v6e"]
+    if "v5 lite" in kind or "v5lite" in kind or "v5e" in kind:
+        return TPU_PEAK_FLOPS["v5e"]
+    if "v5" in kind:  # "TPU v5p" / bare "TPU v5"
+        return TPU_PEAK_FLOPS["v5p"]
+    for gen in ("v4", "v3", "v2"):
+        if gen in kind:
+            return TPU_PEAK_FLOPS[gen]
+    return TPU_PEAK_FLOPS["v5e"]
+
+
+# ---------------------------------------------------------------------------
+# FLOPs / MFU accounting (ref: utils.py:39-48)
+# ---------------------------------------------------------------------------
+
+
+def flops_per_token(m: ModelConfig, seq_length: int) -> float:
+    """Training FLOPs per token: 6N + 12·L·h·s — same formula the reference
+    uses so MFU numbers are directly comparable (ref: utils.py:46-47).
+    """
+    n = num_params(m)
+    return 6.0 * n + 12.0 * m.num_hidden_layers * m.hidden_size * seq_length
+
+
+def mfu(tokens_per_second: float, m: ModelConfig, seq_length: int,
+        num_chips: int, peak_flops_per_chip: Optional[float] = None) -> float:
+    """Model FLOPs utilization in [0, 1]."""
+    if peak_flops_per_chip is None:
+        peak_flops_per_chip = device_peak_flops()
+    achieved = tokens_per_second * flops_per_token(m, seq_length)
+    return achieved / (peak_flops_per_chip * num_chips)
+
+
+# ---------------------------------------------------------------------------
+# Formatting / logging (ref: utils.py:12-37)
+# ---------------------------------------------------------------------------
+
+
+def human_format(num: float) -> str:
+    """1234567 -> '1.23M' (ref: utils.py:27-37)."""
+    num = float(f"{num:.3g}")
+    magnitude = 0
+    while abs(num) >= 1000:
+        magnitude += 1
+        num /= 1000.0
+    suffix = ["", "K", "M", "B", "T", "P"][magnitude]
+    return f"{num:f}".rstrip("0").rstrip(".") + suffix
+
+
+def is_logging_host() -> bool:
+    """Single-controller analogue of the reference's wandb-rank gate
+    (ref: train.py:101): under JAX only process 0 logs."""
+    return jax.process_index() == 0
+
+
+def log_print(*args, **kwargs) -> None:
+    """Print from the logging host only (the reference needs an fcntl file
+    lock to serialize per-rank prints, ref: utils.py:12-20; a single
+    controller per host makes that a process_index gate)."""
+    if is_logging_host():
+        print(*args, **kwargs)
+        sys.stdout.flush()
+
+
+class StepTimer:
+    """Wall-clock per-step timing for tokens/s (ref: train.py:220,242)."""
+
+    def __init__(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def lap(self) -> float:
+        now = time.perf_counter()
+        dt = now - self._t0
+        self._t0 = now
+        return dt
+
+
+def training_log_line(step: int, loss: float, tokens_per_sec: float,
+                      tokens_per_sec_per_chip: float, mfu_frac: float,
+                      trained_tokens: int, memory_gb: float = 0.0) -> str:
+    """The per-step console line. Format is a de-facto API consumed by the
+    metrics harvester (ref: train.py:248-259 <-> extract_metrics.py:55-68);
+    tools/extract_metrics.py parses exactly these field names."""
+    return (
+        f"[step {step:06d}] loss: {loss:.4f} | "
+        f"tokens/s: {human_format(tokens_per_sec)} | "
+        f"tokens/s/chip: {human_format(tokens_per_sec_per_chip)} | "
+        f"MFU: {100.0 * mfu_frac:.2f}% | "
+        f"tokens: {human_format(trained_tokens)} | "
+        f"mem: {memory_gb:.1f}GB"
+    )
+
+
+def device_memory_gb() -> float:
+    """Peak on-device memory in GiB if the backend exposes it (the TPU
+    analogue of torch.cuda.memory_reserved, ref: train.py:255)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and "peak_bytes_in_use" in stats:
+            return stats["peak_bytes_in_use"] / (1024 ** 3)
+    except Exception:
+        pass
+    return 0.0

@@ -1,0 +1,124 @@
+"""Checkpoint tests: save/resume round trip, cross-topology resharding on
+restore (the improvement over the reference's identical-topology assertion,
+ref: checkpoint.py:263), and the HF safetensors import/export round trip."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from picotron_tpu.config import (
+    CheckpointConfig, Config, DistributedConfig, ModelConfig, TrainingConfig,
+)
+from picotron_tpu.checkpoint import (
+    CheckpointManager, load_hf_safetensors, save_hf_safetensors,
+)
+from picotron_tpu.mesh import MeshEnv
+from picotron_tpu.models.llama import forward, init_params
+from picotron_tpu.parallel.api import init_sharded_state, make_train_step
+
+
+def make_cfg(tmp_path, **dist):
+    return Config(
+        distributed=DistributedConfig(**dist),
+        model=ModelConfig(dtype="float32", num_attention_heads=8,
+                          num_key_value_heads=4),
+        training=TrainingConfig(seq_length=32, micro_batch_size=1,
+                                gradient_accumulation_steps=1, remat=False),
+        checkpoint=CheckpointConfig(save_dir=str(tmp_path / "ckpt")),
+    )
+
+
+def batch_for(cfg, menv):
+    t = cfg.training
+    b = t.micro_batch_size * cfg.distributed.dp_size
+    toks = jax.random.randint(
+        jax.random.key(7), (t.gradient_accumulation_steps, b, t.seq_length + 1),
+        0, cfg.model.vocab_size)
+    sh = menv.batch_sharding()
+    return (jax.device_put(toks[..., :-1], sh),
+            jax.device_put(toks[..., 1:], sh))
+
+
+def test_save_restore_roundtrip(tmp_path):
+    cfg = make_cfg(tmp_path, dp_size=2, tp_size=2)
+    menv = MeshEnv.from_config(cfg)
+    state = init_sharded_state(cfg, menv, jax.random.key(0))
+    step = make_train_step(cfg, menv)
+    state, _ = step(state, batch_for(cfg, menv))
+
+    mgr = CheckpointManager(cfg, menv)
+    mgr.save(state, trained_tokens=1234)
+    assert mgr.latest_step() == 1
+
+    template = init_sharded_state(cfg, menv, jax.random.key(99))
+    restored, tokens = mgr.restore(template)
+    assert tokens == 1234
+    assert int(restored.step) == 1
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["embedding"]),
+        np.asarray(state.params["embedding"]))
+    # optimizer state restored too (ref: checkpoint.py:256 stores it)
+    got_leaves = jax.tree_util.tree_leaves(restored.opt_state)
+    want_leaves = jax.tree_util.tree_leaves(state.opt_state)
+    assert len(got_leaves) == len(want_leaves)
+    for g, w in zip(got_leaves, want_leaves):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    # the restored state must be directly trainable (placement-consistent)
+    stepped, _ = step(restored, batch_for(cfg, menv))
+    assert int(stepped.step) == 2
+
+
+def test_restore_across_topologies(tmp_path):
+    """Save under dp=2,tp=2 / restore under tp=4: Orbax reshards into the
+    template's shardings — the reference hard-fails on this
+    (ref: checkpoint.py:263 resume assumes identical topology)."""
+    cfg_a = make_cfg(tmp_path, dp_size=2, tp_size=2)
+    menv_a = MeshEnv.from_config(cfg_a)
+    state = init_sharded_state(cfg_a, menv_a, jax.random.key(0))
+    CheckpointManager(cfg_a, menv_a).save(state)
+
+    cfg_b = make_cfg(tmp_path, tp_size=4)
+    menv_b = MeshEnv.from_config(cfg_b)
+    template = init_sharded_state(cfg_b, menv_b, jax.random.key(1))
+    restored, _ = CheckpointManager(cfg_b, menv_b).restore(template)
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["layers"]["q"]),
+        np.asarray(state.params["layers"]["q"]))
+    # restored arrays carry the *new* topology's shardings
+    assert restored.params["layers"]["q"].sharding == template.params["layers"]["q"].sharding
+
+
+def test_hf_safetensors_roundtrip(tmp_path):
+    cfg = ModelConfig(dtype="float32")
+    params = init_params(cfg, jax.random.key(3))
+    save_hf_safetensors(params, str(tmp_path / "hf"))
+    loaded = load_hf_safetensors(str(tmp_path / "hf"), cfg)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        params, loaded)
+    # and the loaded tree actually runs
+    ids = jax.random.randint(jax.random.key(4), (1, 16), 0, cfg.vocab_size)
+    logits = forward(loaded, ids, cfg)
+    assert logits.shape == (1, 16, cfg.vocab_size)
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_tied_head_checkpoint_unties(tmp_path):
+    """A checkpoint without lm_head.weight falls back to embedding^T
+    (ref: checkpoint.py:88-91 force-creates the untied head)."""
+    import os
+    from safetensors.numpy import load_file, save_file
+
+    cfg = ModelConfig(dtype="float32")
+    params = init_params(cfg, jax.random.key(3))
+    save_hf_safetensors(params, str(tmp_path / "hf"))
+    p = str(tmp_path / "hf" / "model.safetensors")
+    tensors = load_file(p)
+    del tensors["lm_head.weight"]
+    save_file(tensors, p)
+
+    loaded = load_hf_safetensors(str(tmp_path / "hf"), cfg)
+    np.testing.assert_array_equal(
+        np.asarray(loaded["lm_head"]),
+        np.asarray(loaded["embedding"]).T)

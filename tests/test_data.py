@@ -1,0 +1,96 @@
+"""Dataloader tests (the reference's test_dataloader.py checks the CP split
+against an inline reference implementation; here the cp/dp splits are
+shardings, so we check shapes, shard contents, determinism, and the epoch
+wraparound — including the wraparound case the reference left commented out,
+ref: tests/test_dataloader.py:180-212)."""
+
+import jax
+import numpy as np
+import pytest
+
+from picotron_tpu.config import Config, DistributedConfig, ModelConfig, TrainingConfig
+from picotron_tpu.data import MicroBatchDataLoader, SyntheticSource, tokenize_and_chunk
+from picotron_tpu.mesh import MeshEnv
+
+
+def make_cfg(**kw):
+    dist = kw.pop("dist", {})
+    return Config(
+        distributed=DistributedConfig(**dist),
+        model=ModelConfig(),
+        training=TrainingConfig(seq_length=32, micro_batch_size=2,
+                                gradient_accumulation_steps=2, **kw),
+    )
+
+
+def test_shapes_and_batch_math():
+    cfg = make_cfg(dist=dict(dp_size=2, cp_size=2, tp_size=2))
+    menv = MeshEnv.from_config(cfg)
+    dl = MicroBatchDataLoader(cfg, menv)
+    assert dl.global_batch_size == 2 * 2 * 2  # mbs * grad_acc * dp (ref: data.py:17)
+    ids, tgt = next(dl)
+    # [grad_acc, mbs * dp, seq]
+    assert ids.shape == (2, 4, 32)
+    assert tgt.shape == (2, 4, 32)
+    # target is input shifted by one
+    np.testing.assert_array_equal(np.asarray(ids)[..., 1:],
+                                  np.asarray(tgt)[..., :-1])
+
+
+def test_sharding_matches_mesh():
+    cfg = make_cfg(dist=dict(dp_size=2, cp_size=2, tp_size=2))
+    menv = MeshEnv.from_config(cfg)
+    dl = MicroBatchDataLoader(cfg, menv)
+    ids, _ = next(dl)
+    # dp shards the batch dim, cp the sequence dim — the reference's
+    # DistributedSampler-by-dp + collate cp slice (ref: data.py:40-45,102-116)
+    shard_shapes = {tuple(s.data.shape) for s in ids.addressable_shards}
+    assert shard_shapes == {(2, 2, 16)}
+    # the cp=0 shard of dp=0 holds the first half of the sequence
+    full = np.asarray(ids)
+    for shard in ids.addressable_shards:
+        idx = shard.index
+        np.testing.assert_array_equal(shard.data, full[idx])
+
+
+def test_deterministic_and_infinite():
+    cfg = make_cfg(num_samples=16)  # 16 blocks; one step consumes 4
+    menv = MeshEnv.from_config(cfg)
+    dl1 = MicroBatchDataLoader(cfg, menv)
+    dl2 = MicroBatchDataLoader(cfg, menv)
+    a = np.asarray(next(dl1)[0])
+    b = np.asarray(next(dl2)[0])
+    np.testing.assert_array_equal(a, b)  # same seed -> same stream
+    # 16 blocks / 4 per step = 4 steps per epoch; step 5 wraps
+    for _ in range(4):
+        next(dl1)
+    assert dl1.epoch == 1  # wrapped around without raising
+    wrapped = np.asarray(next(dl1)[0])
+    assert wrapped.shape == a.shape
+
+
+def test_too_small_dataset_raises():
+    cfg = make_cfg(num_samples=3)  # < 4 rows per step
+    menv = MeshEnv.from_config(cfg)
+    with pytest.raises(ValueError, match="blocks"):
+        MicroBatchDataLoader(cfg, menv)
+
+
+def test_tokenize_and_chunk():
+    datasets = pytest.importorskip("datasets")
+
+    class WordTokenizer:
+        """Minimal tokenizer: one token per character."""
+        def __call__(self, texts):
+            return {"input_ids": [[ord(c) % 97 for c in t] for t in texts]}
+
+    raw = datasets.Dataset.from_dict({"text": ["abcdefgh" * 4, "xyz" * 7]})
+    chunked = tokenize_and_chunk(raw, WordTokenizer(), seq_length=9)
+    # 32 + 21 = 53 tokens -> 5 blocks of 10
+    assert len(chunked) == 5
+    assert all(len(r["input_ids"]) == 10 for r in chunked)
+    # concatenation crosses document boundaries (ref: data.py:70-90 packs
+    # documents back-to-back)
+    flat = [t for r in chunked for t in r["input_ids"]]
+    want = [ord(c) % 97 for c in "abcdefgh" * 4 + "xyz" * 7][:50]
+    assert flat == want
