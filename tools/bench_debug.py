@@ -1,0 +1,30 @@
+import sys, time
+sys.path.insert(0, ".")
+import jax, jax.numpy as jnp
+from picotron_tpu.config import Config, DistributedConfig, ModelConfig, TrainingConfig, resolve_preset
+from picotron_tpu.mesh import MeshEnv
+from picotron_tpu.parallel.api import init_sharded_state, make_train_step
+
+preset = resolve_preset("SmolLM-360M")
+cfg = Config(
+    distributed=DistributedConfig(dp_size=1),
+    model=ModelConfig(name="SmolLM-360M", **preset),
+    training=TrainingConfig(seq_length=2048, micro_batch_size=4, gradient_accumulation_steps=1, remat=True),
+)
+cfg.validate()
+menv = MeshEnv.from_config(cfg)
+state = init_sharded_state(cfg, menv, jax.random.key(0))
+step = make_train_step(cfg, menv)
+toks = jax.random.randint(jax.random.key(1), (1, 4, 2049), 0, cfg.model.vocab_size)
+sh = menv.batch_sharding()
+batch = (jax.device_put(toks[..., :-1], sh), jax.device_put(toks[..., 1:], sh))
+
+state, loss = step(state, batch)
+jax.block_until_ready(state)
+print("warm done")
+for i in range(5):
+    t0 = time.perf_counter()
+    state, loss = step(state, batch)
+    jax.block_until_ready(state)  # block on the FULL state, not just loss
+    dt = time.perf_counter() - t0
+    print(f"step {i}: {dt*1e3:.1f}ms  loss={float(loss):.3f}  tok/s={4*2048/dt:.0f}")
