@@ -61,6 +61,14 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
 
     cfg = load_config(args.config)
+    if cfg.distributed.use_cpu:
+        # The reference's --use_cpu path (gloo + FLASH_ATTEN=0, ref:
+        # create_config.py:64-66): run the full parallel layout on simulated
+        # host devices. Must happen before any backend-initializing jax call.
+        from picotron_tpu.mesh import force_host_device_count
+
+        force_host_device_count(cfg.distributed.world_size)
+        jax.config.update("jax_platforms", "cpu")
     multihost_initialize()
     menv = MeshEnv.from_config(cfg)
     t = cfg.training

@@ -1,0 +1,96 @@
+"""Experiment-tooling tests: config generator round-trip, metrics harvester
+parsing (the log-line format is a de-facto API between utils.training_log_line
+and tools/extract_metrics.py — same contract the reference has between
+train.py prints and its extract_metrics regexes), and the job scheduler's
+status state machine."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+
+
+def load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(TOOLS, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_create_config_roundtrip(tmp_path):
+    cc = load_tool("create_config")
+    args = cc.build_parser().parse_args([
+        "--exp-name", "dp2_tp2", "--out-dir", str(tmp_path),
+        "--model", "debug-tiny", "--dp", "2", "--tp", "2",
+        "--seq-len", "64", "--mbs", "2", "--grad-acc", "3",
+    ])
+    path = cc.create_single_config(args)
+    from picotron_tpu.config import load_config
+    cfg = load_config(path)
+    assert cfg.distributed.dp_size == 2 and cfg.distributed.tp_size == 2
+    assert cfg.global_batch_size == 2 * 3 * 2
+
+
+def test_create_config_rejects_bad_layout(tmp_path):
+    cc = load_tool("create_config")
+    args = cc.build_parser().parse_args([
+        "--exp-name", "bad", "--out-dir", str(tmp_path),
+        "--model", "debug-tiny", "--tp", "3",  # 4 heads % 3 != 0
+    ])
+    with pytest.raises(ValueError):
+        cc.create_single_config(args)
+
+
+def test_extract_metrics_parses_log_line(tmp_path):
+    from picotron_tpu.utils import training_log_line
+    em = load_tool("extract_metrics")
+
+    run = tmp_path / "dp4_tp2_pp1_cp1"
+    run.mkdir()
+    lines = [training_log_line(s, 5.0 - 0.1 * s, 12345.0, 1543.1, 0.1854,
+                               s * 512) for s in range(1, 8)]
+    (run / "train.log").write_text("\n".join(lines) + "\n")
+
+    stats = em.process_file(str(run / "train.log"), skip_steps=3)
+    assert stats["steps"] == 4  # steps 4..7
+    assert stats["final_loss"] == pytest.approx(4.3)
+    assert stats["mean_mfu_pct"] == pytest.approx(18.54)
+    assert stats["mean_tokens_per_sec"] == pytest.approx(12300, rel=0.01)
+
+    rows = em.aggregate(str(tmp_path), skip_steps=3)
+    assert rows[0]["dp"] == 4 and rows[0]["tp"] == 2
+    assert (run / "metrics.csv").exists()
+
+
+def test_parse_human_inverts_human_format():
+    from picotron_tpu.utils import human_format
+    em = load_tool("extract_metrics")
+    for v in (950.0, 12300.0, 27200000.0):
+        assert em.parse_human(human_format(v)) == pytest.approx(v, rel=0.01)
+
+
+def test_job_status_machine(tmp_path):
+    sj = load_tool("submit_jobs")
+    run = tmp_path / "run_a"
+    run.mkdir()
+    (run / "config.json").write_text("{}")
+
+    jobs = sj.discover_jobs(str(tmp_path))
+    assert len(jobs) == 1
+    job = jobs[0]
+    assert job.status == "init"
+    job.set_status("running")
+    assert job.status == "running"
+
+    # post-mortem classification (ref: base_job.slurm:82-94)
+    (run / "train.log").write_text("... RESOURCE_EXHAUSTED: out of memory ...")
+    assert job.classify(returncode=1) == "oom"
+    (run / "train.log").write_text("... DEADLINE_EXCEEDED ...")
+    assert job.classify(returncode=1) == "timeout"
+    (run / "train.log").write_text("some other crash")
+    assert job.classify(returncode=1) == "fail"
+    assert job.classify(returncode=0) == "completed"

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Experiment config generator — parity with the reference's create_config.py.
+
+Writes `<out_dir>/<exp_name>/config.json` in the (reference-compatible) JSON
+schema from CLI flags (ref: create_config.py:78-106), prints the global-batch
+math (ref: create_config.py:71-73). Model hyperparameters resolve from the
+built-in preset registry instead of a network AutoConfig fetch
+(ref: create_config.py:51-55) — TPU pods frequently have zero egress.
+
+Example:
+  python tools/create_config.py --exp-name smol-dp4tp2 --out-dir runs \\
+      --model SmolLM-1.7B --dp 4 --tp 2 --pp 2 --seq-len 2048 \\
+      --mbs 4 --grad-acc 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from picotron_tpu.config import config_from_dict, resolve_preset  # noqa: E402
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="picotron-tpu config generator")
+    p.add_argument("--exp-name", required=True)
+    p.add_argument("--out-dir", default="runs")
+    # parallel layout (ref: create_config.py --tp/--cp/--dp/--pp)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--cp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--pp-engine", default="1f1b", choices=["1f1b", "afab"])
+    # model
+    p.add_argument("--model", default="HuggingFaceTB/SmolLM-1.7B")
+    p.add_argument("--num-hidden-layers", type=int, default=None,
+                   help="override the preset's layer count "
+                        "(ref: create_config.py:56-59)")
+    p.add_argument("--num-attention-heads", type=int, default=None)
+    p.add_argument("--num-key-value-heads", type=int, default=None)
+    p.add_argument("--attn-impl", default="auto",
+                   choices=["auto", "flash", "reference", "ring"])
+    p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
+    # training (ref: create_config.py --mbs/--grad-acc/--seq-len)
+    p.add_argument("--mbs", type=int, default=1)
+    p.add_argument("--grad-acc", type=int, default=1)
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--learning-rate", type=float, default=3e-4)
+    p.add_argument("--total-train-steps", type=int, default=200)
+    p.add_argument("--no-remat", action="store_true")
+    # dataset
+    p.add_argument("--dataset", default="synthetic")
+    p.add_argument("--subset", default=None)
+    p.add_argument("--split", default="train")
+    p.add_argument("--tokenizer", default=None)
+    # checkpoint / logging
+    p.add_argument("--save-frequency", type=int, default=0)
+    p.add_argument("--use-wandb", action="store_true")
+    p.add_argument("--use-cpu", action="store_true",
+                   help="run the layout on simulated host devices (the "
+                        "reference's --use_cpu, ref: create_config.py:64-66)")
+    return p
+
+
+def create_single_config(args) -> str:
+    model_overrides = {
+        k: v for k, v in dict(
+            num_hidden_layers=args.num_hidden_layers,
+            num_attention_heads=args.num_attention_heads,
+            num_key_value_heads=args.num_key_value_heads,
+        ).items() if v is not None
+    }
+    preset = resolve_preset(args.model)
+    seq_len = args.seq_len
+    if seq_len > preset["max_position_embeddings"]:
+        preset["max_position_embeddings"] = seq_len
+
+    raw = {
+        "distributed": {
+            "tp_size": args.tp, "cp_size": args.cp, "pp_size": args.pp,
+            "dp_size": args.dp, "pp_engine": args.pp_engine,
+            "use_cpu": args.use_cpu,
+        },
+        "model": {
+            "name": args.model, **preset, **model_overrides,
+            "dtype": args.dtype, "attn_impl": args.attn_impl,
+        },
+        "training": {
+            "seq_length": seq_len,
+            "micro_batch_size": args.mbs,
+            "gradient_accumulation_steps": args.grad_acc,
+            "learning_rate": args.learning_rate,
+            "total_train_steps": args.total_train_steps,
+            "remat": not args.no_remat,
+        },
+        "dataset": {
+            "name": args.dataset, "subset_name": args.subset,
+            "split": args.split, "tokenizer_name": args.tokenizer,
+        },
+        "checkpoint": {"save_frequency": args.save_frequency},
+        "logging": {"use_wandb": args.use_wandb, "run_name": args.exp_name},
+    }
+    cfg = config_from_dict(raw)  # validates
+
+    exp_dir = os.path.join(args.out_dir, args.exp_name)
+    os.makedirs(exp_dir, exist_ok=True)
+    path = os.path.join(exp_dir, "config.json")
+    with open(path, "w") as f:
+        json.dump(raw, f, indent=2)
+
+    # ref: create_config.py:71-73 prints the same math
+    print(f"config -> {path}")
+    print(f"  mesh: dp={args.dp} pp={args.pp} cp={args.cp} tp={args.tp} "
+          f"({cfg.distributed.world_size} chips)")
+    print(f"  global_batch_size = mbs {args.mbs} x grad_acc {args.grad_acc} "
+          f"x dp {args.dp} = {cfg.global_batch_size} "
+          f"({cfg.tokens_per_step} tokens/step)")
+    return path
+
+
+if __name__ == "__main__":
+    create_single_config(build_parser().parse_args())

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Log -> CSV benchmark harvester — parity with the reference's
+extract_metrics.py.
+
+Walks an experiment directory, regex-parses each run's training log for the
+per-step metric line emitted by picotron_tpu.utils.training_log_line (the log
+format is a de-facto API, same contract as the reference's train.py print <->
+extract_metrics.py regexes, ref: extract_metrics.py:55-68), skips warmup
+steps, and writes per-run `metrics.csv` plus a sweep-level
+`global_metrics.csv` (ref: extract_metrics.py:91-99,147-195). Parallel-layout
+parameters are decoded from directory names like `dp8_tp2_pp1_cp1`
+(ref: extract_metrics.py:8-23).
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import re
+from statistics import mean
+
+# Matches picotron_tpu.utils.training_log_line output.
+LINE_RE = re.compile(
+    r"\[step (?P<step>\d+)\] loss: (?P<loss>[\d.]+|-?nan|-?inf) \| "
+    r"tokens/s: (?P<tps>[\d.]+[KMBT]?) \| "
+    r"tokens/s/chip: (?P<tpsc>[\d.]+[KMBT]?) \| "
+    r"MFU: (?P<mfu>[\d.]+)%"
+)
+
+NAME_RE = re.compile(r"(dp|tp|pp|cp)(\d+)")
+
+_SUFFIX = {"K": 1e3, "M": 1e6, "B": 1e9, "T": 1e12}
+
+
+def parse_human(s: str) -> float:
+    """'13.5K' -> 13500.0 (inverse of utils.human_format)."""
+    if s and s[-1] in _SUFFIX:
+        return float(s[:-1]) * _SUFFIX[s[-1]]
+    return float(s)
+
+
+def decode_run_name(name: str) -> dict:
+    """'dp8_tp2_pp1_cp1_...' -> {'dp': 8, 'tp': 2, ...}
+    (ref: extract_metrics.py:8-23)."""
+    return {k: int(v) for k, v in NAME_RE.findall(name)}
+
+
+def process_file(path: str, skip_steps: int = 3) -> dict | None:
+    """Mean tokens/s/chip and MFU over post-warmup steps
+    (ref: extract_metrics.py:83-89 skips the first 3 steps)."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            m = LINE_RE.search(line)
+            if m:
+                rows.append({
+                    "step": int(m.group("step")),
+                    "loss": float(m.group("loss")),
+                    "tokens_per_sec": parse_human(m.group("tps")),
+                    "tokens_per_sec_per_chip": parse_human(m.group("tpsc")),
+                    "mfu_pct": float(m.group("mfu")),
+                })
+    rows = [r for r in rows if r["step"] > skip_steps]
+    if not rows:
+        return None
+    # A diverged run must be visible in the sweep, not silently dropped —
+    # final_loss will read nan/inf.
+    return {
+        "steps": len(rows),
+        "final_loss": rows[-1]["loss"],
+        "mean_tokens_per_sec": mean(r["tokens_per_sec"] for r in rows),
+        "mean_tokens_per_sec_per_chip": mean(
+            r["tokens_per_sec_per_chip"] for r in rows),
+        "mean_mfu_pct": mean(r["mfu_pct"] for r in rows),
+    }
+
+
+def find_log(run_dir: str) -> str | None:
+    for name in ("train.log", "log.txt", "stdout.log"):
+        p = os.path.join(run_dir, name)
+        if os.path.exists(p):
+            return p
+    logs = [f for f in os.listdir(run_dir) if f.endswith(".log")]
+    return os.path.join(run_dir, logs[0]) if logs else None
+
+
+def aggregate(exp_dir: str, skip_steps: int = 3) -> list[dict]:
+    results = []
+    for name in sorted(os.listdir(exp_dir)):
+        run_dir = os.path.join(exp_dir, name)
+        if not os.path.isdir(run_dir):
+            continue
+        log = find_log(run_dir)
+        if log is None:
+            continue
+        stats = process_file(log, skip_steps)
+        if stats is None:
+            continue
+        row = {"run": name, **decode_run_name(name), **stats}
+        results.append(row)
+        # per-run metrics.csv (ref: extract_metrics.py:91-99)
+        with open(os.path.join(run_dir, "metrics.csv"), "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=list(row.keys()))
+            w.writeheader()
+            w.writerow(row)
+    return results
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="harvest metrics from run logs")
+    ap.add_argument("exp_dir", help="directory of runs (one subdir per run)")
+    ap.add_argument("--skip-steps", type=int, default=3,
+                    help="warmup steps to exclude (ref default: 3)")
+    args = ap.parse_args()
+
+    results = aggregate(args.exp_dir, args.skip_steps)
+    if not results:
+        print(f"no parsable logs under {args.exp_dir}")
+        return
+    fields = sorted({k for r in results for k in r}, key=lambda k: (k != "run", k))
+    out = os.path.join(args.exp_dir, "global_metrics.csv")
+    with open(out, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=fields)
+        w.writeheader()
+        for r in results:
+            w.writerow(r)
+    print(f"{len(results)} runs -> {out}")
+    for r in results:
+        print(f"  {r['run']}: {r['mean_tokens_per_sec_per_chip']:.0f} tok/s/chip, "
+              f"{r['mean_mfu_pct']:.1f}% MFU, loss {r['final_loss']:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Experiment job scheduler — parity with the reference's submit_slurm_jobs.py.
+
+Walks an experiment directory (one subdir per run, each holding a
+`config.json` from tools/create_config.py) and drives each job through the
+reference's `status.txt` state machine INIT -> PENDING -> RUNNING ->
+{COMPLETED, FAIL, OOM, TIMEOUT} (ref: submit_slurm_jobs.py:8-16,25-53), with
+`--only fail|oom|timeout|pending|init` re-filtering and resubmission
+(ref: submit_slurm_jobs.py:157-172) and a status table printer
+(ref: submit_slurm_jobs.py:116-147).
+
+Launchers:
+- `--launcher local` (default): runs each job as a subprocess on this host,
+  tees output to train.log, and classifies the outcome by exit code + log
+  grep — the reference does its post-mortem classification the same way
+  (OutOfMemoryError / illegal memory access / Timeout greps,
+  ref: template/base_job.slurm:82-94; on TPU the OOM signature is XLA's
+  RESOURCE_EXHAUSTED).
+- `--launcher slurm`: renders a batch script per job (one process per TPU
+  host; `jax.distributed.initialize` picks up the SLURM environment, see
+  picotron_tpu.mesh.multihost_initialize) and submits via sbatch with
+  optional `--dependency afterany` chaining (ref: submit_slurm_jobs.py:104-113).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+# ref: submit_slurm_jobs.py:8-16
+STATUSES = ("init", "pending", "running", "completed", "fail", "oom", "timeout")
+
+OOM_PATTERNS = ("RESOURCE_EXHAUSTED", "Out of memory", "OutOfMemoryError")
+TIMEOUT_PATTERNS = ("DEADLINE_EXCEEDED", "Timeout", "timed out")
+
+# The grep alternations are rendered from the same pattern constants the
+# local launcher classifies with, so both launchers agree on oom/timeout.
+SLURM_TEMPLATE = """#!/bin/bash
+#SBATCH --job-name={name}
+#SBATCH --nodes={nodes}
+#SBATCH --ntasks-per-node=1
+#SBATCH --output={run_dir}/train.log
+#SBATCH --time={time_limit}
+echo running > {run_dir}/status.txt
+srun python -m picotron_tpu.train --config {run_dir}/config.json
+code=$?
+if [ $code -eq 0 ]; then echo completed > {run_dir}/status.txt
+elif grep -qE '{oom_re}' {run_dir}/train.log; then echo oom > {run_dir}/status.txt
+elif grep -qE '{timeout_re}' {run_dir}/train.log; then echo timeout > {run_dir}/status.txt
+else echo fail > {run_dir}/status.txt
+fi
+"""
+
+
+class Job:
+    def __init__(self, run_dir: str):
+        self.run_dir = run_dir
+        self.name = os.path.basename(run_dir.rstrip("/"))
+        self.config = os.path.join(run_dir, "config.json")
+        self.status_file = os.path.join(run_dir, "status.txt")
+        if not os.path.exists(self.status_file):
+            self.set_status("init")
+
+    @property
+    def status(self) -> str:
+        try:
+            with open(self.status_file) as f:
+                s = f.read().strip().lower()
+            return s if s in STATUSES else "init"
+        except OSError:
+            return "init"
+
+    def set_status(self, s: str) -> None:
+        with open(self.status_file, "w") as f:
+            f.write(s + "\n")
+
+    def classify(self, returncode: int) -> str:
+        """Exit-code + log-grep post-mortem (ref: base_job.slurm:82-94)."""
+        if returncode == 0:
+            return "completed"
+        log_path = os.path.join(self.run_dir, "train.log")
+        try:
+            with open(log_path, errors="replace") as f:
+                f.seek(max(0, os.path.getsize(log_path) - 50_000))
+                tail = f.read()
+        except OSError:
+            tail = ""
+        if any(p in tail for p in OOM_PATTERNS):
+            return "oom"
+        if any(p in tail for p in TIMEOUT_PATTERNS):
+            return "timeout"
+        return "fail"
+
+
+def discover_jobs(exp_dir: str) -> list[Job]:
+    jobs = []
+    for name in sorted(os.listdir(exp_dir)):
+        run_dir = os.path.join(exp_dir, name)
+        if os.path.isdir(run_dir) and os.path.exists(
+                os.path.join(run_dir, "config.json")):
+            jobs.append(Job(run_dir))
+    return jobs
+
+
+def run_local(job: Job, timeout: float | None) -> str:
+    job.set_status("running")
+    log_path = os.path.join(job.run_dir, "train.log")
+    t0 = time.time()
+    with open(log_path, "w") as log:
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "picotron_tpu.train",
+                 "--config", job.config],
+                stdout=log, stderr=subprocess.STDOUT,
+                cwd=REPO_ROOT, timeout=timeout,
+            )
+            status = job.classify(proc.returncode)
+        except subprocess.TimeoutExpired:
+            status = "timeout"
+    job.set_status(status)
+    print(f"  {job.name}: {status} ({time.time() - t0:.0f}s)")
+    return status
+
+
+def submit_slurm(job: Job, nodes: int, time_limit: str,
+                 depend_on: str | None) -> str | None:
+    script = os.path.join(job.run_dir, "job.slurm")
+    with open(script, "w") as f:
+        f.write(SLURM_TEMPLATE.format(
+            name=job.name, nodes=nodes, run_dir=os.path.abspath(job.run_dir),
+            time_limit=time_limit,
+            oom_re="|".join(OOM_PATTERNS),
+            timeout_re="|".join(TIMEOUT_PATTERNS)))
+    cmd = ["sbatch", "--parsable"]
+    if depend_on:
+        cmd.append(f"--dependency=afterany:{depend_on}")  # ref: :104-113
+    cmd.append(script)
+    out = subprocess.run(cmd, capture_output=True, text=True)
+    if out.returncode != 0:
+        print(f"  {job.name}: sbatch failed: {out.stderr.strip()}")
+        job.set_status("fail")
+        return None
+    job.set_status("pending")
+    job_id = out.stdout.strip().split(";")[0]
+    print(f"  {job.name}: submitted as {job_id}")
+    return job_id
+
+
+def print_table(jobs: list[Job]) -> None:
+    """ref: submit_slurm_jobs.py:116-147."""
+    counts: dict[str, int] = {}
+    width = max((len(j.name) for j in jobs), default=4)
+    print(f"{'run'.ljust(width)}  status")
+    for j in jobs:
+        s = j.status
+        counts[s] = counts.get(s, 0) + 1
+        print(f"{j.name.ljust(width)}  {s}")
+    print("--")
+    print("  ".join(f"{k}:{v}" for k, v in sorted(counts.items())))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="picotron-tpu job scheduler")
+    ap.add_argument("exp_dir")
+    ap.add_argument("--launcher", choices=["local", "slurm"], default="local")
+    ap.add_argument("--only", choices=list(STATUSES), default=None,
+                    help="resubmit only jobs currently in this status "
+                         "(ref: submit_slurm_jobs.py --only)")
+    ap.add_argument("--status", action="store_true",
+                    help="print the status table and exit")
+    ap.add_argument("--nodes", type=int, default=1)
+    ap.add_argument("--time-limit", default="02:00:00")
+    ap.add_argument("--job-timeout", type=float, default=None,
+                    help="per-job wall-clock limit for the local launcher (s)")
+    ap.add_argument("--chain", action="store_true",
+                    help="chain slurm jobs with --dependency=afterany")
+    args = ap.parse_args()
+
+    jobs = discover_jobs(args.exp_dir)
+    if not jobs:
+        print(f"no runs with config.json under {args.exp_dir}")
+        return
+    if args.status:
+        print_table(jobs)
+        return
+
+    if args.only:
+        jobs = [j for j in jobs if j.status == args.only]
+    else:
+        # default: everything not already completed or in flight
+        jobs = [j for j in jobs if j.status in ("init", "fail", "oom", "timeout")]
+    print(f"{len(jobs)} job(s) to run")
+
+    prev_id = None
+    for job in jobs:
+        if args.launcher == "local":
+            run_local(job, args.job_timeout)
+        else:
+            prev_id = submit_slurm(job, args.nodes, args.time_limit,
+                                   prev_id if args.chain else None)
+
+    print_table(discover_jobs(args.exp_dir))
+
+
+if __name__ == "__main__":
+    main()
