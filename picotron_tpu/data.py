@@ -33,6 +33,28 @@ import numpy as np
 from picotron_tpu.config import Config
 
 
+def cp_sequence_permutation(cfg: Config):
+    """Permutation applied to the sequence axis before the P('cp') sharding,
+    or None for the identity (contiguous) layout.
+
+    Zigzag: with 2*cp equal chunks, cp shard r receives chunks (r, 2cp-1-r)
+    — one early + one late chunk, so causal-attention work is balanced
+    around the ring. Token-level semantics are unchanged: the model reads
+    true global positions from `parallel.api.make_parallel_ctx`, which must
+    agree with this layout (both derive from cfg.distributed.cp_layout).
+    """
+    d, s = cfg.distributed, cfg.training.seq_length
+    if d.cp_size <= 1 or d.cp_layout != "zigzag":
+        return None
+    half = s // (2 * d.cp_size)
+    chunks = []
+    for r in range(d.cp_size):
+        chunks.append(np.arange(r * half, (r + 1) * half))
+        hi = 2 * d.cp_size - 1 - r
+        chunks.append(np.arange(hi * half, (hi + 1) * half))
+    return np.concatenate(chunks)
+
+
 # ---------------------------------------------------------------------------
 # Tokenize + chunk (ref: data.py:57-100)
 # ---------------------------------------------------------------------------
@@ -47,18 +69,21 @@ def tokenize_and_chunk(dataset, tokenizer, seq_length: int,
 
     Returns a dataset of {"input_ids": [seq_length + 1]} rows.
     """
+    from picotron_tpu.native import make_packer
+
     block = seq_length + 1
+    # ONE packer shared across map batches: the partial tail carries over, so
+    # no tokens are lost at batch boundaries (the reference drops the tail of
+    # every map batch, ref: data.py:70-90; under num_proc > 1 each worker
+    # carries within its shard).
+    packer = make_packer(block)
 
     def tok_group(batch):
         texts = batch[text_column]
         out = tokenizer(texts)["input_ids"]
-        concat = list(itertools.chain.from_iterable(out))
-        n_blocks = len(concat) // block
-        return {
-            "input_ids": [
-                concat[i * block:(i + 1) * block] for i in range(n_blocks)
-            ]
-        }
+        packer.feed(np.fromiter(itertools.chain.from_iterable(out),
+                                dtype=np.int32))
+        return {"input_ids": packer.take().tolist()}
 
     return dataset.map(
         tok_group,
@@ -150,6 +175,7 @@ class MicroBatchDataLoader:
         self.epoch = 0
         self.cursor = 0
         self.sharding = menv.batch_sharding()
+        self.cp_perm = cp_sequence_permutation(cfg)
 
     def _build_source(self):
         d = self.cfg.dataset
@@ -185,6 +211,13 @@ class MicroBatchDataLoader:
             t.micro_batch_size * self.cfg.distributed.dp_size,
             self.seq_length + 1,
         )
-        ids = jax.device_put(blocks[..., :-1], self.sharding)
-        targets = jax.device_put(blocks[..., 1:], self.sharding)
-        return ids, targets
+        ids = blocks[..., :-1]
+        targets = blocks[..., 1:]
+        if self.cp_perm is not None:
+            # Reorder the sequence so the contiguous P('cp') shards receive
+            # the zigzag chunks; targets were shifted BEFORE permuting, so
+            # each token still predicts its true successor.
+            ids = ids[..., self.cp_perm]
+            targets = targets[..., self.cp_perm]
+        return (jax.device_put(ids, self.sharding),
+                jax.device_put(targets, self.sharding))
