@@ -117,6 +117,12 @@ class DistributedConfig:
     pp_size: int = 1
     dp_size: int = 1
     pp_engine: str = "1f1b"  # "1f1b" | "afab"
+    # Sequence layout across cp shards: "zigzag" gives each shard one early
+    # and one late chunk so causal attention work is balanced around the ring
+    # (the reference splits contiguously and carries the known imbalance +
+    # a zigzag TODO, ref: data.py:105-109, tests/test_dataloader.py:136).
+    # "contiguous" reproduces the reference layout.
+    cp_layout: str = "zigzag"
     # Accepted for reference-JSON compatibility; ignored (XLA picks transport).
     backend: str = "jax"
     use_cpu: bool = False
@@ -131,6 +137,9 @@ class DistributedConfig:
                 raise ValueError(f"{name} must be >= 1, got {getattr(self, name)}")
         if self.pp_engine not in ("1f1b", "afab"):
             raise ValueError(f"pp_engine must be '1f1b' or 'afab', got {self.pp_engine!r}")
+        if self.cp_layout not in ("zigzag", "contiguous"):
+            raise ValueError(
+                f"cp_layout must be 'zigzag' or 'contiguous', got {self.cp_layout!r}")
 
 
 @dataclass(frozen=True)
@@ -196,6 +205,10 @@ class TrainingConfig:
     max_tokens: Optional[int] = None
     # Gradient rematerialization for long-context / big-model memory savings.
     remat: bool = True
+    # "full" recomputes everything in backward (max memory savings);
+    # "dots" saves matmul outputs and recomputes only elementwise ops —
+    # usually within a few % of no-remat speed at a fraction of the memory.
+    remat_policy: str = "dots"
 
 
 @dataclass(frozen=True)
@@ -269,10 +282,20 @@ class Config:
             raise ValueError("num_key_value_heads must be divisible by tp_size")
         if m.vocab_size % d.tp_size != 0:
             raise ValueError("vocab_size must be divisible by tp_size")
+        if t.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', got {t.remat_policy!r}")
         if t.seq_length < 1:
             raise ValueError(f"seq_length must be >= 1, got {t.seq_length}")
         if t.seq_length % d.cp_size != 0:
             raise ValueError("seq_length must be divisible by cp_size")
+        if (d.cp_size > 1 and d.cp_layout == "zigzag"
+                and t.seq_length % (2 * d.cp_size) != 0):
+            raise ValueError(
+                f"zigzag cp_layout needs seq_length divisible by 2*cp_size "
+                f"({2 * d.cp_size}); got {t.seq_length}. Use "
+                f"cp_layout='contiguous' or adjust seq_length."
+            )
         if t.seq_length > m.max_position_embeddings:
             # Same bound the reference applies by construction (ref:
             # train.py:159 sets seq_length == max_position_embeddings).

@@ -34,6 +34,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from picotron_tpu.config import ModelConfig
 from picotron_tpu.ops.attention import sdpa_attention
@@ -85,6 +86,8 @@ class ParallelCtx:
     positions: Optional[jnp.ndarray] = None
     # gradient checkpointing over decoder layers
     remat: bool = False
+    # "full" | "dots" (save matmul outputs, recompute elementwise only)
+    remat_policy: str = "dots"
 
 
 DEFAULT_CTX = ParallelCtx()
@@ -187,6 +190,10 @@ def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
     # K/V stay unexpanded (n_kv heads) — attention impls handle GQA so the
     # CP ring permutes and flash streams the small K/V.
     out = ctx.attn(q, k, v, ctx.positions)  # [B, S, n_q, D]
+    # Named so the "dots" remat policy can save it: the Pallas kernel isn't
+    # a dot_general at the jaxpr level, so without the name the whole flash
+    # forward would be recomputed during backward.
+    out = checkpoint_name(out, "attn_out")
     out = out.reshape(b, s, n_q * d)
     out = out @ lp["o"].astype(dt)
     return ctx.g(out)  # row-parallel exit: psum-over-tp fwd / identity bwd
@@ -223,7 +230,16 @@ def run_layers(layer_params: Params, x: jnp.ndarray, cfg: ModelConfig,
         return decoder_layer(h, lp, cfg, ctx, cos, sin), None
 
     if ctx.remat:
-        body = jax.checkpoint(body)
+        if ctx.remat_policy == "dots":
+            # matmul outputs + the named attention output are saved; only
+            # cheap elementwise work is recomputed in backward.
+            policy = jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names("attn_out"),
+            )
+        else:
+            policy = None
+        body = jax.checkpoint(body, policy=policy)
     x, _ = jax.lax.scan(body, x, layer_params)
     return x
 

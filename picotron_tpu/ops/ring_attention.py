@@ -112,12 +112,14 @@ def ring_attention(
 
     # Send K/V to the next cp index, receive from the previous — after step t
     # this device holds the block originating at cp index (my - t) mod n
-    # (ref: cp_communications.py:22-36 builds the same ring).
+    # (ref: cp_communications.py:22-36 builds the same ring). The position
+    # vector travels the ring WITH its K/V block, so any sequence layout
+    # (contiguous, zigzag, ...) masks correctly without this function knowing
+    # the layout — each block's positions are simply its owner's q_positions.
     fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    kv_positions = q_positions
 
     for step in range(n):
-        src = (my - step) % n
-        kv_positions = src * s_local + jnp.arange(s_local)
         out_blk, lse_blk = attn_block(
             q, k, v,
             causal=True,
@@ -129,5 +131,6 @@ def ring_attention(
         if step != n - 1:
             k = lax.ppermute(k, axis, fwd_perm)
             v = lax.ppermute(v, axis, fwd_perm)
+            kv_positions = lax.ppermute(kv_positions, axis, fwd_perm)
 
     return out_acc.astype(q.dtype)

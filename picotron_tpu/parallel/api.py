@@ -50,7 +50,17 @@ def make_parallel_ctx(cfg: Config) -> ParallelCtx:
     """
     d = cfg.distributed
     s_local = cfg.training.seq_length // d.cp_size
-    positions = lax.axis_index("cp") * s_local + jnp.arange(s_local)
+    idx = lax.axis_index("cp")
+    if d.cp_size > 1 and d.cp_layout == "zigzag":
+        # Must mirror data.cp_sequence_permutation: shard r holds chunks
+        # (r, 2cp-1-r) of 2cp chunks — its tokens' global positions.
+        half = s_local // 2
+        lo = idx * half
+        hi = (2 * d.cp_size - 1 - idx) * half
+        positions = jnp.concatenate([lo + jnp.arange(half),
+                                     hi + jnp.arange(half)])
+    else:
+        positions = idx * s_local + jnp.arange(s_local)
 
     # Attention implementation dispatch (the reference routes via the
     # FLASH_ATTEN / CONTEXT_PARALLEL env vars, ref: model.py:148-158):
@@ -91,6 +101,7 @@ def make_parallel_ctx(cfg: Config) -> ParallelCtx:
         gather_logits=partial(gather_logits, axis="tp"),
         positions=positions,
         remat=cfg.training.remat,
+        remat_policy=cfg.training.remat_policy,
     )
 
 

@@ -24,7 +24,8 @@ def make_cfg(**kw):
 
 
 def test_shapes_and_batch_math():
-    cfg = make_cfg(dist=dict(dp_size=2, cp_size=2, tp_size=2))
+    cfg = make_cfg(dist=dict(dp_size=2, cp_size=2, tp_size=2,
+                             cp_layout="contiguous"))
     menv = MeshEnv.from_config(cfg)
     dl = MicroBatchDataLoader(cfg, menv)
     assert dl.global_batch_size == 2 * 2 * 2  # mbs * grad_acc * dp (ref: data.py:17)
@@ -35,6 +36,30 @@ def test_shapes_and_batch_math():
     # target is input shifted by one
     np.testing.assert_array_equal(np.asarray(ids)[..., 1:],
                                   np.asarray(tgt)[..., :-1])
+
+
+def test_zigzag_layout_is_consistent_permutation():
+    """Zigzag reorders the sequence axis; un-permuting must recover the
+    contiguous stream with its shift-by-one target relation intact."""
+    from picotron_tpu.data import cp_sequence_permutation
+
+    cfg = make_cfg(dist=dict(dp_size=2, cp_size=2, tp_size=2))
+    assert cfg.distributed.cp_layout == "zigzag"  # the default
+    menv = MeshEnv.from_config(cfg)
+    dl = MicroBatchDataLoader(cfg, menv)
+    ids, tgt = next(dl)
+    perm = cp_sequence_permutation(cfg)
+    inv = np.argsort(perm)
+    ids_lin = np.asarray(ids)[..., inv]
+    tgt_lin = np.asarray(tgt)[..., inv]
+    np.testing.assert_array_equal(ids_lin[..., 1:], tgt_lin[..., :-1])
+    # each cp shard holds one early chunk and one late chunk
+    s, cp = cfg.training.seq_length, cfg.distributed.cp_size
+    half = s // (2 * cp)
+    shard0 = perm[: s // cp]
+    np.testing.assert_array_equal(shard0[:half], np.arange(half))
+    np.testing.assert_array_equal(
+        shard0[half:], np.arange(s - half, s))
 
 
 def test_sharding_matches_mesh():

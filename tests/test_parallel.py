@@ -44,11 +44,18 @@ def global_batch(cfg, key=0):
 
 
 def run_parallel(cfg, steps=3):
+    from picotron_tpu.data import cp_sequence_permutation
+
     menv = MeshEnv.from_config(cfg)
     state = init_sharded_state(cfg, menv, jax.random.key(0))
     step = make_train_step(cfg, menv)
     sh = NamedSharding(menv.mesh, P(None, "dp", "cp"))
     ids, tgt = global_batch(cfg)
+    perm = cp_sequence_permutation(cfg)
+    if perm is not None:
+        # mirror the dataloader's zigzag reorder (the parity invariant: the
+        # permuted layout must train identically to the single-device run)
+        ids, tgt = ids[..., perm], tgt[..., perm]
     batch = (jax.device_put(ids, sh), jax.device_put(tgt, sh))
     losses = []
     for _ in range(steps):
@@ -78,7 +85,9 @@ def run_single(cfg_parallel, steps=3):
     dict(dp_size=2, tp_size=2),
     dict(dp_size=2, tp_size=4),
     dict(cp_size=4),
+    dict(cp_size=4, cp_layout="contiguous"),
     dict(dp_size=2, cp_size=2, tp_size=2),
+    dict(dp_size=2, cp_size=2, tp_size=2, cp_layout="contiguous"),
     dict(pp_size=2),
     dict(dp_size=2, pp_size=2),
     dict(pp_size=2, tp_size=2),

@@ -61,6 +61,38 @@ def test_ring_matches_dense_grads():
                                    rtol=5e-5, atol=5e-5, err_msg=f"d{name}")
 
 
+def test_ring_zigzag_layout_matches_dense():
+    """Zigzag layout: each cp shard holds one early + one late chunk; the
+    position vector travels the ring with its K/V block, so the same ring
+    code stays correct (the balanced layout the reference left as a TODO,
+    ref: tests/test_dataloader.py:136)."""
+    cp, s = 4, 32
+    menv = MeshEnv.create(cp=cp)
+    q, k, v = qkv(s=s)
+    half = s // (2 * cp)
+    perm = np.concatenate([
+        np.concatenate([np.arange(r * half, (r + 1) * half),
+                        np.arange((2 * cp - 1 - r) * half,
+                                  (2 * cp - r) * half)])
+        for r in range(cp)
+    ])
+    pos_global = jnp.asarray(perm)
+
+    def ring_zz(q, k, v, pos):
+        return ring_attention(q, k, v, q_positions=pos)
+
+    got = jax.jit(jax.shard_map(
+        ring_zz, mesh=menv.mesh,
+        in_specs=(P(None, "cp"), P(None, "cp"), P(None, "cp"), P("cp")),
+        out_specs=P(None, "cp"),
+    ))(q[:, perm], k[:, perm], v[:, perm], pos_global)
+    want = sdpa_attention(q, k, v, causal=True)
+    # got is in zigzag order; un-permute to compare
+    inv = np.argsort(perm)
+    np.testing.assert_allclose(np.asarray(got)[:, inv], np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_ring_bf16_close_to_dense():
     menv = MeshEnv.create(cp=4)
     q, k, v = qkv(dtype=jnp.bfloat16)
