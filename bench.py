@@ -87,18 +87,19 @@ def main() -> None:
 
     for _ in range(max(args.warmup, 1)):  # >=1 so compile stays out of the timing
         state, loss = step(state, batch)
-    jax.block_until_ready(state)
-    float(loss)
+    float(loss)  # value fetch: cannot return before the warmup chain ran
 
-    # Per-step host sync on the loss scalar. With donated (aliased) state
-    # buffers, block_until_ready can return before the execution chain has
-    # actually run on some backends; a device-to-host value fetch cannot lie.
-    # Steps remain serialized by the state dependency, so wall-clock across
-    # the loop is true step time (± one optimizer tail).
+    # Time N chained steps, fetching ONLY the final loss. The data dependency
+    # (loss_N needs state_{N-1} needs ... state_0) forces every step to have
+    # executed before the fetch returns, while avoiding a host<->device
+    # round-trip per step (which inflates step time by the transport latency;
+    # ~100ms/step over a remote-tunnel backend). block_until_ready is NOT
+    # trustworthy here — with donated (aliased) state buffers it can return
+    # before the execution chain has run; a value fetch cannot lie.
     t0 = time.perf_counter()
     for _ in range(args.steps):
         state, loss = step(state, batch)
-        float(loss)
+    final_loss = float(loss)
     dt = time.perf_counter() - t0
 
     tokens_per_step = b_global * args.grad_acc * args.seq
@@ -118,7 +119,7 @@ def main() -> None:
         "device_kind": jax.devices()[0].device_kind,
         "peak_flops_per_chip": peak,
         "flops_per_token": flops_per_token(cfg.model, args.seq),
-        "loss": float(loss),
+        "loss": final_loss,
     }))
 
 

@@ -46,6 +46,7 @@ SLURM_TEMPLATE = """#!/bin/bash
 #SBATCH --ntasks-per-node=1
 #SBATCH --output={run_dir}/train.log
 #SBATCH --time={time_limit}
+cd "{repo_root}" || {{ echo fail > "{run_dir}/status.txt"; exit 1; }}
 echo running > {run_dir}/status.txt
 srun python -m picotron_tpu.train --config {run_dir}/config.json
 code=$?
@@ -133,7 +134,7 @@ def submit_slurm(job: Job, nodes: int, time_limit: str,
     with open(script, "w") as f:
         f.write(SLURM_TEMPLATE.format(
             name=job.name, nodes=nodes, run_dir=os.path.abspath(job.run_dir),
-            time_limit=time_limit,
+            time_limit=time_limit, repo_root=REPO_ROOT,
             oom_re="|".join(OOM_PATTERNS),
             timeout_re="|".join(TIMEOUT_PATTERNS)))
     cmd = ["sbatch", "--parsable"]
@@ -201,8 +202,13 @@ def main() -> None:
         if args.launcher == "local":
             run_local(job, args.job_timeout)
         else:
-            prev_id = submit_slurm(job, args.nodes, args.time_limit,
-                                   prev_id if args.chain else None)
+            new_id = submit_slurm(job, args.nodes, args.time_limit,
+                                  prev_id if args.chain else None)
+            if new_id is not None:
+                # A failed submission keeps the previous anchor so later
+                # jobs stay chained (serialized) rather than all starting
+                # concurrently.
+                prev_id = new_id
 
     print_table(discover_jobs(args.exp_dir))
 
