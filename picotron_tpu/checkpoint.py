@@ -46,7 +46,13 @@ from picotron_tpu.train_step import TrainState
 class CheckpointManager:
     """Save/restore TrainState under `<save_dir>/step_<n>/` (ref:
     checkpoint.py:232-278; the per-(tp,pp)-rank filename scheme collapses to
-    one logical global checkpoint)."""
+    one logical global checkpoint).
+
+    Multihost requirement: `save_dir` must be a filesystem shared by every
+    host (GCS / NFS — the standard Cloud TPU arrangement, and what Orbax
+    itself needs to assemble the sharded array write). meta.json is written
+    by process 0 and read by all processes on restore, which assumes the
+    same shared view."""
 
     def __init__(self, cfg: Config, menv=None, directory: Optional[str] = None):
         import orbax.checkpoint as ocp
@@ -60,7 +66,8 @@ class CheckpointManager:
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step:08d}")
 
-    def save(self, state: TrainState, trained_tokens: int = 0) -> str:
+    def save(self, state: TrainState, trained_tokens: int = 0,
+             dataloader_state: Optional[dict] = None) -> str:
         step = int(state.step)
         path = self._step_dir(step)
         self._ckptr.save(
@@ -78,6 +85,8 @@ class CheckpointManager:
                 "trained_tokens": int(trained_tokens),
                 "config": self.cfg.to_json_dict(),
             }
+            if dataloader_state is not None:
+                meta["dataloader"] = dataloader_state
             with open(os.path.join(path, "meta.json"), "w") as f:
                 json.dump(meta, f, indent=2)
         return path
@@ -93,9 +102,11 @@ class CheckpointManager:
         return max(steps) if steps else None
 
     def restore(self, state_template: TrainState,
-                step: Optional[int] = None) -> tuple[TrainState, int]:
+                step: Optional[int] = None) -> tuple[TrainState, dict]:
         """Restore into the shardings/dtypes of `state_template` (any
-        topology — resharding is Orbax's job). Returns (state, trained_tokens).
+        topology — resharding is Orbax's job). Returns (state, meta) where
+        meta carries at least trained_tokens, plus the dataloader position
+        when the checkpoint recorded one.
         """
         if step is None:
             step = self.latest_step()
@@ -103,6 +114,29 @@ class CheckpointManager:
                 raise FileNotFoundError(
                     f"no checkpoints under {self.directory}")
         path = self._step_dir(step)
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        # Checkpoints store the PP-padded layer stack. Even splits are
+        # canonical (no padding), so any-topology restore works; an uneven
+        # split bakes its pp into the padded shape, which a different pp
+        # cannot consume — fail with the story rather than a shape error.
+        src = meta.get("config", {})
+        src_m, src_d = src.get("model", {}), src.get("distributed", {})
+        if src_m.get("num_hidden_layers") and src_d.get("pp_size"):
+            from picotron_tpu.models.llama import pp_layer_placement
+
+            src_padded, _ = pp_layer_placement(
+                src_m["num_hidden_layers"], src_d["pp_size"])
+            tmpl_padded = jax.tree.leaves(
+                state_template.params["layers"])[0].shape[0]
+            if src_padded != tmpl_padded:
+                raise ValueError(
+                    f"checkpoint was saved with an uneven PP layer split "
+                    f"(padded stack {src_padded}, pp={src_d['pp_size']}); "
+                    f"restoring into padded stack {tmpl_padded} is not "
+                    f"supported — resume with the same pp_size or use a "
+                    f"layer count divisible by both"
+                )
         template = {
             "params": state_template.params,
             "opt_state": state_template.opt_state,
@@ -122,12 +156,10 @@ class CheckpointManager:
             lambda r, t: jax.device_put(r, t.sharding)
             if hasattr(t, "sharding") else r,
             restored, template)
-        with open(os.path.join(path, "meta.json")) as f:
-            meta = json.load(f)
         state = TrainState(params=restored["params"],
                            opt_state=restored["opt_state"],
                            step=restored["step"])
-        return state, meta.get("trained_tokens", 0)
+        return state, meta
 
 
 # ---------------------------------------------------------------------------

@@ -196,6 +196,9 @@ class TrainingConfig:
     adam_beta1: float = 0.9
     adam_beta2: float = 0.999
     adam_eps: float = 1e-8
+    # "float32" | "bfloat16": bf16 halves Adam-moment memory (update math
+    # stays fp32) — the knob that fits SmolLM-1.7B's optimizer on one v5e.
+    adam_moments_dtype: str = "float32"
     grad_clip_norm: float = 0.0  # 0 disables clipping
     total_train_steps: int = 200
     seq_length: int = 1024
@@ -307,14 +310,10 @@ class Config:
             raise ValueError(
                 f"pp_size ({d.pp_size}) cannot exceed num_hidden_layers ({m.num_hidden_layers})"
             )
-        if m.num_hidden_layers % d.pp_size != 0:
-            # The stacked-layer pp sharding needs an even stage split (the
-            # reference instead pushes the remainder to early stages,
-            # ref: pipeline_parallel.py:42-51).
-            raise ValueError(
-                f"num_hidden_layers ({m.num_hidden_layers}) must be divisible "
-                f"by pp_size ({d.pp_size})"
-            )
+        # num_hidden_layers % pp_size may be nonzero: the stacked layer axis
+        # is padded with identity (all-zero) layers and the remainder goes to
+        # early stages (ref: pipeline_parallel.py:42-51 distribute_layers);
+        # see models.llama.pp_layer_placement.
         if t.gradient_accumulation_steps < 1:
             raise ValueError(
                 f"gradient_accumulation_steps must be >= 1, got "

@@ -25,6 +25,8 @@ restructured for a single-controller SPMD runtime:
 from __future__ import annotations
 
 import itertools
+import queue as queue_mod
+import threading
 from typing import Any, Iterator, Optional
 
 import jax
@@ -69,16 +71,22 @@ def tokenize_and_chunk(dataset, tokenizer, seq_length: int,
 
     Returns a dataset of {"input_ids": [seq_length + 1]} rows.
     """
-    from picotron_tpu.native import make_packer
-
     block = seq_length + 1
-    # ONE packer shared across map batches: the partial tail carries over, so
-    # no tokens are lost at batch boundaries (the reference drops the tail of
-    # every map batch, ref: data.py:70-90; under num_proc > 1 each worker
-    # carries within its shard).
-    packer = make_packer(block)
+    # ONE packer per worker process, shared across its map batches: the
+    # partial tail carries over, so no tokens are lost at batch boundaries
+    # (the reference drops the tail of every map batch, ref: data.py:70-90;
+    # under num_proc > 1 each worker carries within its shard). Constructed
+    # lazily INSIDE the closure: a ctypes-backed packer captured at closure
+    # build time can't be pickled by HF datasets' fingerprinting, and
+    # num_proc > 1 on spawn platforms would not inherit it.
+    packer_box: list = []
 
     def tok_group(batch):
+        if not packer_box:
+            from picotron_tpu.native import make_packer
+
+            packer_box.append(make_packer(block))
+        packer = packer_box[0]
         texts = batch[text_column]
         out = tokenizer(texts)["input_ids"]
         packer.feed(np.fromiter(itertools.chain.from_iterable(out),
@@ -158,7 +166,17 @@ class MicroBatchDataLoader:
     """Yields (input_ids, targets) pairs shaped
     [grad_acc, global_batch, seq_length], device_put into the mesh's
     P(None, 'dp', 'cp') sharding. Iteration is infinite: exhausting the
-    source bumps the epoch and continues (ref: data.py:118-137).
+    source bumps the epoch and continues (ref: data.py:118-137). The tail of
+    each epoch is dropped when len(source) is not a multiple of the global
+    batch (up to global_batch - 1 blocks — the reference's drop_last
+    behavior, ref: data.py:40-45).
+
+    `dataset.num_workers > 0` enables host-side prefetch: a background
+    thread assembles and device_puts up to num_workers batches ahead, so
+    host batch assembly overlaps device compute (the role of the
+    reference's DataLoader num_workers). `state` / `set_state` expose the
+    (epoch, cursor) position for checkpoint resume; set_state must be
+    called before the first `next()`.
     """
 
     def __init__(self, cfg: Config, menv, source=None):
@@ -176,6 +194,27 @@ class MicroBatchDataLoader:
         self.cursor = 0
         self.sharding = menv.batch_sharding()
         self.cp_perm = cp_sequence_permutation(cfg)
+        self._consumed_state = {"epoch": 0, "cursor": 0}
+        self._prefetch_depth = cfg.dataset.num_workers
+        self._queue = None  # created lazily on first __next__
+
+    # -- resume position (persisted in checkpoint meta; ADVICE r1) --------
+
+    @property
+    def state(self) -> dict:
+        """Position after the last batch handed out — persist this at
+        checkpoint time so resume does not replay consumed data. With
+        prefetch enabled this intentionally lags the production cursor by
+        the queued (not yet trained-on) batches."""
+        return dict(self._consumed_state)
+
+    def set_state(self, st: dict) -> None:
+        if self._queue is not None:
+            raise RuntimeError("set_state must be called before iteration "
+                               "starts (prefetch already running)")
+        self.epoch = int(st["epoch"])
+        self.cursor = int(st["cursor"])
+        self._consumed_state = {"epoch": self.epoch, "cursor": self.cursor}
 
     def _build_source(self):
         d = self.cfg.dataset
@@ -198,7 +237,8 @@ class MicroBatchDataLoader:
     def __iter__(self) -> Iterator:
         return self
 
-    def __next__(self):
+    def _assemble_next(self):
+        """Produce the next (batch, post_state) at the production cursor."""
         n = self.global_batch_size
         if self.cursor + n > len(self.source):
             self.epoch += 1  # ref: data.py:129-133 epoch bump
@@ -219,5 +259,34 @@ class MicroBatchDataLoader:
             # each token still predicts its true successor.
             ids = ids[..., self.cp_perm]
             targets = targets[..., self.cp_perm]
-        return (jax.device_put(ids, self.sharding),
-                jax.device_put(targets, self.sharding))
+        batch = (jax.device_put(ids, self.sharding),
+                 jax.device_put(targets, self.sharding))
+        return batch, {"epoch": self.epoch, "cursor": self.cursor}
+
+    def _produce(self):
+        while not self._stop.is_set():
+            item = self._assemble_next()
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(item, timeout=0.5)
+                    break
+                except queue_mod.Full:
+                    continue
+
+    def close(self) -> None:
+        if self._queue is not None:
+            self._stop.set()
+
+    def __next__(self):
+        if self._prefetch_depth > 0:
+            if self._queue is None:
+                self._queue = queue_mod.Queue(maxsize=self._prefetch_depth)
+                self._stop = threading.Event()
+                self._thread = threading.Thread(target=self._produce,
+                                                daemon=True)
+                self._thread.start()
+            batch, post_state = self._queue.get()
+        else:
+            batch, post_state = self._assemble_next()
+        self._consumed_state = post_state
+        return batch

@@ -146,6 +146,57 @@ def param_count(params: Params) -> int:
 
 
 # ---------------------------------------------------------------------------
+# Uneven pipeline layer distribution (ref: pipeline_parallel.py:42-51)
+# ---------------------------------------------------------------------------
+
+
+def pp_layer_placement(num_layers: int, pp: int):
+    """(padded_size, slot_index[num_layers]) for an uneven layer split.
+
+    The stacked layer axis is padded to pp * ceil(L/pp) so P('pp') divides
+    evenly; stage k holds L//pp (+1 for the first L%pp stages — remainder to
+    early stages, the reference's distribute_layers rule) real layers in its
+    leading slots. Pad slots hold all-zero layer params, which make the
+    decoder layer an *exact identity with exactly-zero gradients*: the
+    residual passes through, every projection output is 0, and every pad
+    param's grad is 0 (each flows through a zero activation or zero weight),
+    so Adam(+wd) keeps pads at zero forever. No masking needed anywhere.
+    """
+    import numpy as np
+
+    per = -(-num_layers // pp)  # ceil
+    counts = [num_layers // pp + (1 if k < num_layers % pp else 0)
+              for k in range(pp)]
+    slots = np.concatenate([
+        np.arange(k * per, k * per + counts[k]) for k in range(pp)
+    ]).astype(np.int32)
+    return per * pp, slots
+
+
+def pad_layers_for_pp(params: Params, num_layers: int, pp: int) -> Params:
+    """Scatter the canonical [L]-stacked layer tree into its [Lp] padded
+    layout (identity when L % pp == 0)."""
+    padded, slots = pp_layer_placement(num_layers, pp)
+    if padded == num_layers:
+        return params
+
+    def pad(x):
+        out = jnp.zeros((padded,) + x.shape[1:], x.dtype)
+        return out.at[slots].set(x)
+
+    return {**params, "layers": jax.tree.map(pad, params["layers"])}
+
+
+def unpad_layers(params: Params, num_layers: int, pp: int) -> Params:
+    """Inverse of pad_layers_for_pp: gather back the canonical [L] stack."""
+    padded, slots = pp_layer_placement(num_layers, pp)
+    if padded == num_layers:
+        return params
+    return {**params,
+            "layers": jax.tree.map(lambda x: x[slots], params["layers"])}
+
+
+# ---------------------------------------------------------------------------
 # Forward pieces (granular so PP schedules can compose them)
 # ---------------------------------------------------------------------------
 
@@ -216,6 +267,22 @@ def decoder_layer(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
     return x
 
 
+def remat_policy_for(name: str):
+    """jax.checkpoint policy for a config remat_policy name.
+
+    "dots" saves matmul outputs + the named attention output, so only cheap
+    elementwise work is recomputed in backward; "full" (None) recomputes
+    everything. Shared by the layer scan here and the pipeline tick scan
+    (parallel/pp.py) so both paths honor the same config knob.
+    """
+    if name == "dots":
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names("attn_out"),
+        )
+    return None
+
+
 def run_layers(layer_params: Params, x: jnp.ndarray, cfg: ModelConfig,
                ctx: ParallelCtx = DEFAULT_CTX,
                cos: jnp.ndarray | None = None,
@@ -230,16 +297,7 @@ def run_layers(layer_params: Params, x: jnp.ndarray, cfg: ModelConfig,
         return decoder_layer(h, lp, cfg, ctx, cos, sin), None
 
     if ctx.remat:
-        if ctx.remat_policy == "dots":
-            # matmul outputs + the named attention output are saved; only
-            # cheap elementwise work is recomputed in backward.
-            policy = jax.checkpoint_policies.save_from_both_policies(
-                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-                jax.checkpoint_policies.save_only_these_names("attn_out"),
-            )
-        else:
-            policy = None
-        body = jax.checkpoint(body, policy=policy)
+        body = jax.checkpoint(body, policy=remat_policy_for(ctx.remat_policy))
     x, _ = jax.lax.scan(body, x, layer_params)
     return x
 

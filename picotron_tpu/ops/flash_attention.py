@@ -9,20 +9,25 @@ per-block kernel (ref: the CP ring's pure-torch blockwise math + TODOs
 wishing for flash, context_parallel.py:22-23,112-155).
 
 Design:
-- Inputs [B, S, H, D] are viewed [B, H, S, D]; the grid runs one program per
-  (batch, q-head, q-block). K/V for the whole (cp-local) sequence sit in
-  VMEM; the kernel loops KV blocks with online-softmax (m, l, acc) updates —
-  the standard flash recurrence.
+- Inputs [B, S, H, D] are viewed [B, H, S, D]; the KV dimension is a *grid
+  dimension*, not a kernel-internal loop: grid (batch, q-head, q-block,
+  kv-block) with online-softmax (m, l, acc) carries in VMEM scratch across
+  the sequential kv dimension. Only one K/V block is VMEM-resident per step,
+  so per-shard sequence length is bounded by HBM, not VMEM — the
+  long-context regime CP exists for (16k+ per shard) compiles and runs.
 - **GQA in the index map**: the K/V BlockSpecs map q-head h to kv-head
   h // (Hq // Hkv), so grouped heads never materialize (the reference
   repeat_interleaves K/V to full Hq first, model.py:142-143).
 - **Masking by explicit positions**, not block indices: the causal mask is
   `q_pos >= kv_pos` on position vectors, so context-parallel shards (local
-  index != global position) and future zigzag layouts reuse the same kernel.
+  index != global position) and the zigzag layout reuse the same kernel.
   Blocks that are entirely masked skip their matmuls via `pl.when`.
 - **Custom VJP with Pallas backward kernels**: dq via a q-block-parallel
   kernel, dk/dv via a kv-block-parallel kernel, both recomputing P from the
   saved LSE (flash-attn 2's backward structure; no S x S materialization).
+  The dkv grid is (batch, KV-head, kv-block): under GQA the group's query
+  heads are accumulated *inside* the program (an inner sequential grid
+  dimension), not materialized per-q-head and summed after.
 
 Numerics: fp32 accumulation for scores/softmax/output regardless of input
 dtype, matching sdpa_attention.
@@ -65,23 +70,26 @@ def _out_struct(shape, dtype, *operands):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(kmin_ref, qpos_ref, kpos_ref, q_ref, k_ref, v_ref, o_ref,
-                lse_ref, *, sm_scale: float, block_k: int, causal: bool):
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale          # [BQ, D]
-    bq = q.shape[0]
-    sk = k_ref.shape[2]
+def _fwd_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_ref, l_ref, acc_ref, *, sm_scale: float, causal: bool,
+                num_kv: int):
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
     qpos = qpos_ref[0]                                       # [BQ]
-    num_kv = sk // block_k
+    kpos = kpos_ref[0]                                       # [BK]
+    visible = (jnp.max(qpos) >= jnp.min(kpos)) if causal else (ki >= 0)
 
-    m = jnp.full((bq,), _NEG_INF, jnp.float32)
-    l = jnp.zeros((bq,), jnp.float32)
-    acc = jnp.zeros((bq, q.shape[1]), jnp.float32)
-
-    def body(j, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
-        kpos = kpos_ref[0, pl.ds(j * block_k, block_k)]      # [BK]
-
+    @pl.when(visible)  # skip fully-masked blocks entirely
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale       # [BQ, D]
+        k_blk = k_ref[0, 0].astype(jnp.float32)              # [BK, D]
+        v_blk = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)              # [BQ, BK]
@@ -89,39 +97,30 @@ def _fwd_kernel(kmin_ref, qpos_ref, kpos_ref, q_ref, k_ref, v_ref, o_ref,
             mask = qpos[:, None] >= kpos[None, :]
             s = jnp.where(mask, s, _NEG_INF)
 
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m - m_new)                            # exp(-inf-(-inf))
-        alpha = jnp.where(m <= _NEG_INF, 0.0, alpha)          # guarded to 0
+        m_prev = m_ref[...][:, 0]                            # [BQ]
+        l_prev = l_ref[...][:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)                      # exp(-inf-(-inf))
+        alpha = jnp.where(m_prev <= _NEG_INF, 0.0, alpha)    # guarded to 0
         p = jnp.exp(s - m_new[:, None])
         p = jnp.where(m_new[:, None] <= _NEG_INF, 0.0, p)
-        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        l = l * alpha + jnp.sum(p, axis=-1)
-        return m_new, l, acc
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
-    if causal:
-        # Skip blocks with no unmasked entry. Per-block position minima come
-        # from SMEM (kmin_ref) — Mosaic cannot prove lane alignment for a
-        # dynamic scalar load from the VMEM position vector.
-        q_hi = jnp.max(qpos)
-
-        def guarded(j, carry):
-            k_lo = kmin_ref[0, j]
-            return jax.lax.cond(q_hi >= k_lo, lambda c: body(j, c),
-                                lambda c: c, carry)
-
-        m, l, acc = jax.lax.fori_loop(0, num_kv, guarded, (m, l, acc))
-    else:
-        m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m, l, acc))
-
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # True -inf for fully-masked rows — the CP ring's LSE merge keys on
-    # isinf, matching sdpa_attention's convention.
-    lse = jnp.where(l == 0.0, -jnp.inf, m + jnp.log(l_safe))
-    lse_ref[0, 0] = lse.astype(jnp.float32)[:, None]
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        m = m_ref[...][:, 0]
+        l = l_ref[...][:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+        # True -inf for fully-masked rows — the CP ring's LSE merge keys on
+        # isinf, matching sdpa_attention's convention.
+        lse = jnp.where(l == 0.0, -jnp.inf, m + jnp.log(l_safe))
+        lse_ref[0, 0] = lse.astype(jnp.float32)[:, None]
 
 
 def _fwd(q4, k4, v4, qpos, kpos, sm_scale, causal, block_q, block_k,
@@ -132,34 +131,44 @@ def _fwd(q4, k4, v4, qpos, kpos, sm_scale, causal, block_q, block_k,
     n_rep = hq // hkv
     bq = _pick_block(sq, block_q)
     bk = _pick_block(sk, block_k)
+    num_kv = sk // bk
 
-    grid = (b, hq, sq // bq)
-    kmin = kpos.reshape(1, sk // bk, bk).min(axis=-1)  # [1, num_kv_blocks]
+    grid = (b, hq, sq // bq, num_kv)
     kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, block_k=bk, causal=causal)
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, num_kv=num_kv)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),                  # kmin
-            pl.BlockSpec((1, bq), lambda bi, hi, qi: (0, qi)),      # qpos
-            pl.BlockSpec((1, sk), lambda bi, hi, qi: (0, 0)),       # kpos
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, sk, d),
-                         lambda bi, hi, qi, n_rep=n_rep: (bi, hi // n_rep, 0, 0)),
-            pl.BlockSpec((1, 1, sk, d),
-                         lambda bi, hi, qi, n_rep=n_rep: (bi, hi // n_rep, 0, 0)),
+            pl.BlockSpec((1, bq), lambda bi, hi, qi, ki: (0, qi)),  # qpos
+            pl.BlockSpec((1, bk), lambda bi, hi, qi, ki: (0, ki)),  # kpos
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, qi, ki, n_rep=n_rep:
+                         (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, qi, ki, n_rep=n_rep:
+                         (bi, hi // n_rep, ki, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ],
         out_shape=[
             _out_struct((b, hq, sq, d), q4.dtype, q4, k4, v4, qpos, kpos),
             _out_struct((b, hq, sq, 1), jnp.float32, q4, k4, v4, qpos, kpos),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),   # m (broadcast over lanes)
+            pltpu.VMEM((bq, 128), jnp.float32),   # l
+            pltpu.VMEM((bq, d), jnp.float32),     # acc
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
         interpret=interpret,
-    )(kmin, qpos, kpos, q4, k4, v4)
+    )(qpos, kpos, q4, k4, v4)
     return out, lse
 
 
@@ -168,24 +177,27 @@ def _fwd(q4, k4, v4, qpos, kpos, sm_scale, causal, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(kmin_ref, qpos_ref, kpos_ref, q_ref, k_ref, v_ref,
-                   do_ref, lse_ref, delta_ref, dq_ref, *, sm_scale: float,
-                   block_k: int, causal: bool):
-    q = q_ref[0, 0].astype(jnp.float32)                      # [BQ, D]
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0, :, 0]                                # [BQ]
-    delta = delta_ref[0, 0, :, 0]                            # [BQ]
+def _bwd_dq_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, dq_acc_ref, *, sm_scale: float,
+                   causal: bool, num_kv: int):
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
     qpos = qpos_ref[0]
-    bq = q.shape[0]
-    sk = k_ref.shape[2]
-    num_kv = sk // block_k
+    kpos = kpos_ref[0]
+    visible = (jnp.max(qpos) >= jnp.min(kpos)) if causal else (ki >= 0)
 
-    dq = jnp.zeros_like(q)
-
-    def body(j, dq):
-        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
-        kpos = kpos_ref[0, pl.ds(j * block_k, block_k)]
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)                  # [BQ, D]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0]                            # [BQ]
+        delta = delta_ref[0, 0, :, 0]                        # [BQ]
+        k_blk = k_ref[0, 0].astype(jnp.float32)              # [BK, D]
+        v_blk = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -198,44 +210,39 @@ def _bwd_dq_kernel(kmin_ref, qpos_ref, kpos_ref, q_ref, k_ref, v_ref,
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * sm_scale
-        return dq + jax.lax.dot_general(
+        dq_acc_ref[...] = dq_acc_ref[...] + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        q_hi = jnp.max(qpos)
-
-        def guarded(j, dq):
-            k_lo = kmin_ref[0, j]
-            return jax.lax.cond(q_hi >= k_lo, lambda c: body(j, c),
-                                lambda c: c, dq)
-
-        dq = jax.lax.fori_loop(0, num_kv, guarded, dq)
-    else:
-        dq = jax.lax.fori_loop(0, num_kv, body, dq)
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc_ref[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(qmax_ref, qpos_ref, kpos_ref, q_ref, k_ref, v_ref,
-                    do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
-                    sm_scale: float, block_q: int, causal: bool):
-    k_blk = k_ref[0, 0].astype(jnp.float32)                  # [BK, D]
-    v_blk = v_ref[0, 0].astype(jnp.float32)
-    kpos = kpos_ref[0]                                       # [BK]
-    sq = q_ref.shape[2]
-    bk = k_blk.shape[0]
-    num_q = sq // block_q
+def _bwd_dkv_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
+                    sm_scale: float, causal: bool, num_inner: int):
+    # Inner sequential dim folds (group-head, q-block): the GQA group
+    # accumulates into this kv-head's dk/dv inside the program.
+    t = pl.program_id(3)
 
-    dk = jnp.zeros_like(k_blk)
-    dv = jnp.zeros_like(v_blk)
+    @pl.when(t == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
-    def body(i, carry):
-        dk, dv = carry
-        q_blk = q_ref[0, 0, pl.ds(i * block_q, block_q)].astype(jnp.float32)
-        do = do_ref[0, 0, pl.ds(i * block_q, block_q)].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), 0]
-        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), 0]
-        qpos = qpos_ref[0, pl.ds(i * block_q, block_q)]
+    qpos = qpos_ref[0]
+    kpos = kpos_ref[0]
+    visible = (jnp.max(qpos) >= jnp.min(kpos)) if causal else (t >= 0)
+
+    @pl.when(visible)
+    def _compute():
+        k_blk = k_ref[0, 0].astype(jnp.float32)              # [BK, D]
+        v_blk = v_ref[0, 0].astype(jnp.float32)
+        q_blk = q_ref[0, 0].astype(jnp.float32)              # [BQ, D]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
         s = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale    # [BQ, BK]
@@ -244,31 +251,21 @@ def _bwd_dkv_kernel(qmax_ref, qpos_ref, kpos_ref, q_ref, k_ref, v_ref,
             s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(lse[:, None] <= _NEG_INF, 0.0, p)
-        dv = dv + jax.lax.dot_general(
+        dv_acc_ref[...] = dv_acc_ref[...] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * sm_scale
-        dk = dk + jax.lax.dot_general(
+        dk_acc_ref[...] = dk_acc_ref[...] + jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk, dv
 
-    if causal:
-        k_lo = jnp.min(kpos)
-
-        def guarded(i, carry):
-            q_hi = qmax_ref[0, i]
-            return jax.lax.cond(q_hi >= k_lo, lambda c: body(i, c),
-                                lambda c: c, carry)
-
-        dk, dv = jax.lax.fori_loop(0, num_q, guarded, (dk, dv))
-    else:
-        dk, dv = jax.lax.fori_loop(0, num_q, body, (dk, dv))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    @pl.when(t == num_inner - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
 def _bwd(q4, k4, v4, o4, lse, do4, dlse, qpos, kpos, sm_scale, causal,
@@ -278,6 +275,8 @@ def _bwd(q4, k4, v4, o4, lse, do4, dlse, qpos, kpos, sm_scale, causal,
     n_rep = hq // hkv
     bq = _pick_block(sq, block_q)
     bk = _pick_block(sk, block_k)
+    num_q = sq // bq
+    num_kv = sk // bk
 
     # delta = rowsum(do * o) [B, Hq, Sq] (flash-attn 2's D term). The LSE
     # cotangent folds in here: dL/ds_ij = p_ij * (dp_ij - delta_i + dlse_i)
@@ -288,67 +287,84 @@ def _bwd(q4, k4, v4, o4, lse, do4, dlse, qpos, kpos, sm_scale, causal,
                     axis=-1, keepdims=True)
     delta = delta - dlse.astype(jnp.float32)
 
-    kmin = kpos.reshape(1, sk // bk, bk).min(axis=-1)
-    qmax = qpos.reshape(1, sq // bq, bq).max(axis=-1)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, block_k=bk,
-                          causal=causal),
-        grid=(b, hq, sq // bq),
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          num_kv=num_kv),
+        grid=(b, hq, num_q, num_kv),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, bq), lambda bi, hi, qi: (0, qi)),
-            pl.BlockSpec((1, sk), lambda bi, hi, qi: (0, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, sk, d),
-                         lambda bi, hi, qi, n_rep=n_rep: (bi, hi // n_rep, 0, 0)),
-            pl.BlockSpec((1, 1, sk, d),
-                         lambda bi, hi, qi, n_rep=n_rep: (bi, hi // n_rep, 0, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bi, hi, qi, ki: (0, qi)),
+            pl.BlockSpec((1, bk), lambda bi, hi, qi, ki: (0, ki)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, qi, ki, n_rep=n_rep:
+                         (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, qi, ki, n_rep=n_rep:
+                         (bi, hi // n_rep, ki, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         out_shape=_out_struct((b, hq, sq, d), q4.dtype,
                               q4, k4, v4, do4, lse, delta, qpos, kpos),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
         interpret=interpret,
-    )(kmin, qpos, kpos, q4, k4, v4, do4, lse, delta)
+    )(qpos, kpos, q4, k4, v4, do4, lse, delta)
 
-    # dk/dv over full query heads, then sum grouped heads for GQA.
-    dk_full, dv_full = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, block_q=bq,
-                          causal=causal),
-        grid=(b, hq, sk // bk),
+    # dk/dv: one program per (batch, KV head, kv-block); the inner
+    # sequential dim walks the group's query heads x q-blocks, accumulating
+    # into scratch — GQA costs no extra memory traffic or post-hoc sum.
+    num_inner = n_rep * num_q
+
+    def qhead(hi, t):
+        return hi * n_rep + t // num_q
+
+    def qblk(t):
+        return t % num_q
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          num_inner=num_inner),
+        grid=(b, hkv, num_kv, num_inner),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, sq), lambda bi, hi, ki: (0, 0)),
-            pl.BlockSpec((1, bk), lambda bi, hi, ki: (0, ki)),
-            pl.BlockSpec((1, 1, sq, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda bi, hi, ki, n_rep=n_rep: (bi, hi // n_rep, ki, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda bi, hi, ki, n_rep=n_rep: (bi, hi // n_rep, ki, 0)),
-            pl.BlockSpec((1, 1, sq, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, sq, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, sq, 1), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, bq), lambda bi, hi, ki, t: (0, qblk(t))),
+            pl.BlockSpec((1, bk), lambda bi, hi, ki, t: (0, ki)),
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, ki, t: (bi, qhead(hi, t), qblk(t), 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, t: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, t: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, ki, t: (bi, qhead(hi, t), qblk(t), 0)),
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, hi, ki, t: (bi, qhead(hi, t), qblk(t), 0)),
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, hi, ki, t: (bi, qhead(hi, t), qblk(t), 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, t: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, t: (bi, hi, ki, 0)),
         ],
         out_shape=[
-            _out_struct((b, hq, sk, d), q4.dtype,
+            _out_struct((b, hkv, sk, d), k4.dtype,
                         q4, k4, v4, do4, lse, delta, qpos, kpos),
-            _out_struct((b, hq, sk, d), q4.dtype,
+            _out_struct((b, hkv, sk, d), v4.dtype,
                         q4, k4, v4, do4, lse, delta, qpos, kpos),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
         interpret=interpret,
-    )(qmax, qpos, kpos, q4, k4, v4, do4, lse, delta)
+    )(qpos, kpos, q4, k4, v4, do4, lse, delta)
 
-    if n_rep > 1:
-        dk = dk_full.reshape(b, hkv, n_rep, sk, d).sum(axis=2)
-        dv = dv_full.reshape(b, hkv, n_rep, sk, d).sum(axis=2)
-    else:
-        dk, dv = dk_full, dv_full
     return dq, dk.astype(k4.dtype), dv.astype(v4.dtype)
 
 

@@ -4,24 +4,78 @@ The reference uses `torch.optim.AdamW(fused=True)` (ref: train.py:204-209) —
 a CUDA kernel. On TPU, optax's adamw update is a handful of elementwise ops
 that XLA fuses into one kernel per bucket automatically; no custom kernel is
 needed (SURVEY.md §2.3 row `fused AdamW`).
+
+`adam_moments_dtype: "bfloat16"` stores both Adam moments in bf16 (compute
+still fp32): moment memory halves, which is what lets full-depth
+SmolLM-1.7B's optimizer state fit a single 16G v5e chip. The reference has
+no low-precision optimizer option; this is a TPU-memory-driven extension.
 """
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 import optax
 
 from picotron_tpu.config import TrainingConfig
 
 
+def scale_by_adam_low_moments(b1: float, b2: float, eps: float,
+                              moments_dtype) -> optax.GradientTransformation:
+    """scale_by_adam with BOTH moments stored in `moments_dtype` (optax's
+    mu_dtype covers only the first moment). The update math runs in fp32;
+    only the carried state is rounded."""
+
+    def init(params):
+        zeros = lambda p: jnp.zeros_like(p, dtype=moments_dtype)  # noqa: E731
+        return optax.ScaleByAdamState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree.map(zeros, params),
+            nu=jax.tree.map(zeros, params),
+        )
+
+    def update(updates, state, params=None):
+        del params
+        count = state.count + 1
+        mu32 = jax.tree.map(
+            lambda g, m: b1 * m.astype(jnp.float32)
+            + (1 - b1) * g.astype(jnp.float32),
+            updates, state.mu)
+        nu32 = jax.tree.map(
+            lambda g, n: b2 * n.astype(jnp.float32)
+            + (1 - b2) * jnp.square(g.astype(jnp.float32)),
+            updates, state.nu)
+        c1 = 1 - b1 ** count.astype(jnp.float32)
+        c2 = 1 - b2 ** count.astype(jnp.float32)
+        out = jax.tree.map(
+            lambda m, n: (m / c1) / (jnp.sqrt(n / c2) + eps), mu32, nu32)
+        new_state = optax.ScaleByAdamState(
+            count=count,
+            mu=jax.tree.map(lambda m: m.astype(moments_dtype), mu32),
+            nu=jax.tree.map(lambda n: n.astype(moments_dtype), nu32),
+        )
+        return out, new_state
+
+    return optax.GradientTransformation(init, update)
+
+
 def make_optimizer(t: TrainingConfig) -> optax.GradientTransformation:
     steps = [] if t.grad_clip_norm <= 0 else [optax.clip_by_global_norm(t.grad_clip_norm)]
-    steps.append(
-        optax.adamw(
-            learning_rate=t.learning_rate,
-            b1=t.adam_beta1,
-            b2=t.adam_beta2,
-            eps=t.adam_eps,
-            weight_decay=t.weight_decay,
+    if t.adam_moments_dtype == "bfloat16":
+        steps += [
+            scale_by_adam_low_moments(t.adam_beta1, t.adam_beta2, t.adam_eps,
+                                      jnp.bfloat16),
+            optax.add_decayed_weights(t.weight_decay),
+            optax.scale_by_learning_rate(t.learning_rate),
+        ]
+    else:
+        steps.append(
+            optax.adamw(
+                learning_rate=t.learning_rate,
+                b1=t.adam_beta1,
+                b2=t.adam_beta2,
+                eps=t.adam_eps,
+                weight_decay=t.weight_decay,
+            )
         )
-    )
     return optax.chain(*steps)

@@ -30,7 +30,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from picotron_tpu.config import Config
 from picotron_tpu.mesh import MeshEnv
-from picotron_tpu.models.llama import ParallelCtx, init_params, loss_sum_count
+from picotron_tpu.models.llama import (
+    ParallelCtx, init_params, loss_sum_count, pad_layers_for_pp,
+)
 from picotron_tpu.optimizer import make_optimizer
 from picotron_tpu.parallel.sharding import batch_spec, param_shardings, param_specs
 from picotron_tpu.parallel.tp import (
@@ -117,16 +119,24 @@ def _device_grads(params, batch, cfg: Config):
     if cfg.distributed.pp_size > 1:
         # The pipeline scan subsumes the microbatch loop: grad accumulation
         # across microbatches IS the schedule (ref: train.py:225-227
-        # dispatches to the pipeline schedules the same way).
+        # dispatches to the pipeline engines the same way).
         from picotron_tpu.parallel.pp import (
-            pipeline_loss_sum_count, sync_pp_replicated_grads,
+            pipeline_1f1b_grads, pipeline_loss_sum_count,
+            sync_pp_replicated_grads,
         )
 
-        def pp_nll(params):
-            total, count = pipeline_loss_sum_count(params, ids, tgt, cfg, ctx)
-            return total, count
+        if cfg.distributed.pp_engine == "1f1b":
+            # Manual-VJP schedule: grads come out of the scan directly.
+            grads, nll_total, count = pipeline_1f1b_grads(
+                params, ids, tgt, cfg, ctx)
+        else:  # "afab": differentiate through the forward scan
 
-        (nll_total, count), grads = jax.value_and_grad(pp_nll, has_aux=True)(params)
+            def pp_nll(params):
+                total, count = pipeline_loss_sum_count(params, ids, tgt, cfg, ctx)
+                return total, count
+
+            (nll_total, count), grads = jax.value_and_grad(
+                pp_nll, has_aux=True)(params)
         grads = sync_pp_replicated_grads(grads, param_specs(cfg))
         grads = lax.psum(grads, ("dp", "cp"))
         nll_total = lax.psum(nll_total, ("dp", "cp"))
@@ -198,9 +208,15 @@ def init_sharded_state(cfg: Config, menv: MeshEnv, key: jax.Array) -> TrainState
     cfg.validate()
     mesh = menv.mesh
     shardings = param_shardings(cfg, mesh)
-    params = jax.jit(
-        partial(init_params, cfg.model), out_shardings=shardings
-    )(key)
+
+    def init(key):
+        # Pad the layer stack for uneven PP splits (identity zero-layers);
+        # real layers keep exactly the single-device init values.
+        return pad_layers_for_pp(init_params(cfg.model, key),
+                                 cfg.model.num_hidden_layers,
+                                 cfg.distributed.pp_size)
+
+    params = jax.jit(init, out_shardings=shardings)(key)
     opt = make_optimizer(cfg.training)
     # Optimizer moments must mirror the param shardings (Adam mu/nu live
     # wherever their param lives — the reference gets this implicitly from

@@ -8,40 +8,43 @@ pp_communications.py). The mapping:
   layers per stage, embedding on the first stage, norm+head on the last
   (ref: pipeline_parallel.py:13-51). Here the stacked layer pytree is
   *sharded* over 'pp' on its leading layer axis (parallel/sharding.py), so
-  inside shard_map each device's `params['layers']` IS its stage slice; the
-  even `distribute_layers` split (ref: pipeline_parallel.py:42-51) is the
-  sharding rule (layers % pp == 0 enforced at config validation).
+  inside shard_map each device's `params['layers']` IS its stage slice.
 - **Activation transport** — the reference's batched isend/irecv pairs with
   hard cuda synchronization and `CUDA_DEVICE_MAX_CONNECTIONS=1` ordering
   (ref: pp_communications.py:8-46, base_job.slurm:53) become one
   `lax.ppermute` per pipeline tick; XLA orders and overlaps it.
-- **Schedule** — one `lax.scan` over `n_micro + pp - 1` ticks. At tick t,
-  stage s processes microbatch `t - s`: stage 0 ingests embedded microbatch
-  t, every stage runs its layer block, the last stage accumulates a masked
-  loss, activations rotate one stage forward. Differentiating through the
-  scan yields the reverse schedule with transposed ppermutes — the manual
-  `torch.autograd.backward` choreography + grad send/recv of the reference
-  (ref: pipeline_parallel.py:65-75, 94-118) is derived, not written.
-- **Grad-sync deferral** — `require_backward_grad_sync` gating on the last
-  microbatch (ref: pipeline_parallel.py:179-199) falls out of psum-ing once,
-  after the scan (see parallel/api.py).
 
-Schedule semantics per engine (ref: train.py:225-227 dispatch):
-- "afab": exactly this scan — all forwards then all backwards, activations
-  retained per tick (the reference's AFAB stores input/output per microbatch,
-  ref: pipeline_parallel.py:94-118; the scan carry plays that role).
-- "1f1b": currently runs the same scan. True 1F1B's only delta is peak
-  activation memory (<= pp in-flight microbatches instead of n_micro);
-  with per-tick rematerialization the scan already bounds stored state to
-  one carry per tick. An explicit interleaved-vjp schedule is planned.
+Both engines share one stage unit (`_make_stage_fn`): at a given tick, stage
+s applies its layer block to microbatch m, where stage 0 ingests `embed(m)`
+and the last stage scores m against the targets (masked SPMD uniformity —
+every stage traces the same program; under TP the head is vocab-sharded so
+the masked head waste is divided by tp_size).
 
-SPMD uniformity note: every stage traces the same program, so embed and the
-loss head are *computed* on every stage and masked where inapplicable. The
-head matmul is the only nontrivial overhead; under TP it is vocab-sharded
-(tp.vocab_parallel_ce_sum_count), which divides that waste by tp_size.
+**"afab"** (all-forward-all-backward, ref: pipeline_parallel.py:77-118):
+one `lax.scan` over n_micro + pp - 1 ticks; at tick t stage s forwards
+microbatch t - s. Differentiating through the scan yields the reverse
+schedule with transposed ppermutes — the reference's manual
+`torch.autograd.backward` choreography + grad send/recv is derived, not
+written. Memory: scan AD stores per-tick residuals, i.e. O(n_micro) —
+bounded by the tick-level `jax.checkpoint` (which honors the configured
+remat policy) to one boundary activation per tick plus policy-saved values.
+
+**"1f1b"** (ref: pipeline_parallel.py:122-215 warmup/steady/cooldown): a
+synchronous schedule-table scan with *manual* VJP — no AD through the scan.
+Microbatch m's forward runs at stage s on tick 2m + s; its backward at tick
+2m + 2(pp-1) - s. Activation cotangents ride a reverse ppermute; parameter
+gradients accumulate in the scan carry. Stage s holds at most pp - s
+in-flight stage inputs in a size-pp ring buffer — the exact Megatron 1F1B
+bound, *independent of n_micro* (AFAB's live set grows with n_micro). The
+trade: every tick traces one forward + one backward unit and the schedule
+fills only alternate slots per stage, so 1F1B costs up to ~2x AFAB's
+pipeline FLOPs on TPU SPMD. Pick 1f1b when activation memory is the binding
+constraint (long context / deep stages), afab when it is not.
 """
 
 from __future__ import annotations
+
+import dataclasses
 
 import jax
 import jax.numpy as jnp
@@ -49,20 +52,66 @@ from jax import lax
 
 from picotron_tpu.config import Config
 from picotron_tpu.models.llama import (
-    ParallelCtx, compute_dtype, embed, final_hidden, run_layers,
+    ParallelCtx, compute_dtype, embed, final_hidden, remat_policy_for,
+    run_layers,
 )
 from picotron_tpu.ops.losses import cross_entropy_sum_count
 from picotron_tpu.ops.rope import rope_tables
 
 
+def _vary_over(x, want):
+    """Promote x to vary over the mesh axes in `want` (no-op for axes it
+    already varies over). Sound in the safe direction only: it forgets
+    replication knowledge, never asserts it."""
+    have = jax.typeof(x).vma
+    missing = tuple(a for a in ("dp", "pp", "cp", "tp")
+                    if a in want and a not in have)
+    return lax.pcast(x, missing, to="varying") if missing else x
+
+
+def _cast_varying_like(x, target):
+    return _vary_over(x, set(jax.typeof(target).vma))
+
+
+def _make_stage_fn(ids, tgt, m, ctx: ParallelCtx, cos, sin, s_idx, pp):
+    """One stage-forward unit, shared by both engines.
+
+    Returns stage_fn(params, x_buf, m_idx, valid) -> ((y, nll_sum), count):
+    stage 0 consumes embed(ids[m_idx]) (zero-masked when not `valid`), other
+    stages consume the rotated-in activation `x_buf`; the last stage's
+    (nll_sum, count) score microbatch m_idx. Differentiable in params and
+    x_buf (count is aux).
+    """
+    dtype = compute_dtype(m)
+
+    def stage_fn(params, x_buf, m_idx, valid):
+        mb_ids = lax.dynamic_index_in_dim(ids, m_idx, 0, keepdims=False)
+        mb_tgt = lax.dynamic_index_in_dim(tgt, m_idx, 0, keepdims=False)
+        # Zero-mask invalid ingest so garbage never enters the pipe (all
+        # bubble compute then runs on zeros, which every op here keeps
+        # finite — no NaNs can poison the masked accumulators' grads).
+        x0 = embed(params, mb_ids, m, ctx) * valid.astype(dtype)
+        x_in = jnp.where(s_idx == 0, x0, x_buf)
+        y = run_layers(params["layers"], x_in, m, ctx, cos, sin)
+        hf = final_hidden(params, y, m)
+        if ctx.head_ce is not None:
+            total, count = ctx.head_ce(hf, params["lm_head"], mb_tgt)
+        else:
+            logits = hf @ params["lm_head"].astype(hf.dtype)
+            total, count = cross_entropy_sum_count(logits, mb_tgt)
+        return (y, total), count
+
+    return stage_fn
+
+
 def pipeline_loss_sum_count(params, ids, tgt, cfg: Config, ctx: ParallelCtx):
-    """(nll_sum, valid_count) for the full microbatch stream, pipelined over
-    'pp'. Must run inside shard_map with 'pp' (and 'dp','cp','tp') in scope.
+    """AFAB engine: (nll_sum, valid_count) for the full microbatch stream,
+    pipelined over 'pp'. Must run inside shard_map with 'pp' (and
+    'dp','cp','tp') in scope; differentiate through it for gradients.
 
     ids/tgt: [n_micro, mbs_local, s_local] (this device's dp/cp shard,
-    replicated over pp — every stage sees the token stream; stage 0 reads
-    ids, the last stage reads tgt, matching the reference's dataloader
-    feeding all ranks, ref: pipeline_parallel.py:145-155).
+    replicated over pp — every stage sees the token stream, matching the
+    reference's dataloader feeding all ranks, ref: pipeline_parallel.py:145-155).
 
     Outputs are replicated over 'pp' (psum-broadcast from the last stage).
     """
@@ -74,53 +123,34 @@ def pipeline_loss_sum_count(params, ids, tgt, cfg: Config, ctx: ParallelCtx):
 
     cos, sin = rope_tables(m.max_position_embeddings, m.head_dim, m.rope_theta)
     dtype = compute_dtype(m)
-
-    # Pad the ingest stream to n_ticks; shift the target stream so that at
-    # tick t the last stage scores the microbatch it is finishing (t-(pp-1)).
-    ids_p = jnp.pad(ids, ((0, pp - 1), (0, 0), (0, 0)))
-    tgt_p = jnp.pad(tgt, ((pp - 1, 0), (0, 0), (0, 0)))
-    ticks = jnp.arange(n_ticks)
-    in_valid = ticks < n_micro
-    out_valid = ticks >= pp - 1
-
+    # Remat is applied at tick granularity below (so the policy governs what
+    # the scan's AD saves per tick); disable the inner per-layer checkpoint
+    # to avoid nesting two remat regions.
+    ctx_inner = dataclasses.replace(ctx, remat=False)
+    stage_fn = _make_stage_fn(ids, tgt, m, ctx_inner, cos, sin, s_idx, pp)
     fwd_perm = [(i, i + 1) for i in range(pp - 1)]
 
-    def tick(carry, xs):
+    def tick(carry, t):
         x_buf, nll_acc, cnt_acc = carry
-        mb_ids, mb_tgt, v_in, v_out = xs
-
-        # Stage 0 ingests a fresh microbatch; others take the rotated-in
-        # activations. Zero-mask padded ingest ticks so garbage never enters
-        # the pipe (it would reach the last stage as a masked tick anyway,
-        # but non-finite values would poison grads through the mask).
-        x0 = embed(params, mb_ids, m, ctx) * v_in.astype(dtype)
-        x_in = jnp.where(s_idx == 0, x0, x_buf)
-
-        y = run_layers(params["layers"], x_in, m, ctx, cos, sin)
-
-        # Last stage: norm + head + CE on the microbatch leaving the pipe.
-        hf = final_hidden(params, y, m)
-        if ctx.head_ce is not None:
-            total, count = ctx.head_ce(hf, params["lm_head"], mb_tgt)
-        else:
-            logits = hf @ params["lm_head"].astype(hf.dtype)
-            total, count = cross_entropy_sum_count(logits, mb_tgt)
-        take = (s_idx == pp - 1) & v_out
-        nll_acc = nll_acc + jnp.where(take, total, 0.0)
-        cnt_acc = cnt_acc + jnp.where(take, count, 0)
-
-        y_next = lax.ppermute(y, "pp", fwd_perm)
+        d = t - s_idx  # microbatch index this stage works on at tick t
+        on = (d >= 0) & (d < n_micro)
+        m_f = jnp.clip(d, 0, n_micro - 1)
+        (y, nll), cnt = stage_fn(params, x_buf, m_f, on)
+        take = on & (s_idx == pp - 1)
+        nll_acc = nll_acc + jnp.where(take, nll, 0.0)
+        cnt_acc = cnt_acc + jnp.where(take, cnt, 0)
+        y_next = lax.ppermute(y * on.astype(y.dtype), "pp", fwd_perm)
         return (y_next, nll_acc, cnt_acc), None
+
+    body = tick
+    if ctx.remat:
+        body = jax.checkpoint(body, policy=remat_policy_for(ctx.remat_policy))
 
     x0_buf = jnp.zeros((mbs, s_local, m.hidden_size), dtype)
     init = lax.pcast(
         (x0_buf, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
         ("dp", "cp", "pp"), to="varying")
-    body = tick
-    if ctx.remat:
-        body = jax.checkpoint(body)
-    (x_last, nll_sum, cnt), _ = lax.scan(
-        body, init, (ids_p, tgt_p, in_valid, out_valid))
+    (x_last, nll_sum, cnt), _ = lax.scan(body, init, jnp.arange(n_ticks))
 
     # Broadcast the last stage's totals to every stage (masked elsewhere, so
     # psum == select; ref: utils.py:93-98 averages loss on the last PP stage
@@ -128,6 +158,103 @@ def pipeline_loss_sum_count(params, ids, tgt, cfg: Config, ctx: ParallelCtx):
     nll_sum = lax.psum(nll_sum, "pp")
     cnt = lax.psum(cnt, "pp")
     return nll_sum, cnt
+
+
+def pipeline_1f1b_grads(params, ids, tgt, cfg: Config, ctx: ParallelCtx):
+    """1F1B engine: (grads, nll_sum, valid_count), pipelined over 'pp'.
+
+    Unlike the AFAB engine this computes gradients *itself* (manual VJP per
+    tick) — do not differentiate through it. Schedule (synchronous analogue
+    of ref: pipeline_parallel.py:122-215):
+
+        forward  of microbatch m at stage s: tick 2m + s
+        backward of microbatch m at stage s: tick 2m + 2(pp-1) - s
+
+    which is warmup (stage s runs pp-1-s forwards before its first
+    backward), steady 1F1B alternation, cooldown — with at most pp - s
+    microbatch inputs live at stage s. The ring buffer holds stage *inputs*
+    (boundary activations); the backward unit recomputes the stage forward
+    under jax.vjp, so per-tick transient memory follows the configured remat
+    policy via run_layers' inner checkpoint.
+
+    Ring-slot safety (size pp, slot = m mod pp): microbatch m+pp's store at
+    tick 2m + 2pp + s strictly follows m's load at tick 2m + 2(pp-1) - s
+    for every stage; at the last stage the same microbatch's store and load
+    land on one tick, in that order within the tick body.
+
+    Grads of pp-replicated params (embedding / final norm / head) come out
+    nonzero only on the stage that uses them — pass through
+    sync_pp_replicated_grads like the AFAB path's.
+    """
+    m = cfg.model
+    pp = lax.psum(1, "pp")
+    s_idx = lax.axis_index("pp")
+    n_micro, mbs, s_local = ids.shape
+    n_ticks = 2 * n_micro + 2 * (pp - 1) - 1
+
+    cos, sin = rope_tables(m.max_position_embeddings, m.head_dim, m.rope_theta)
+    dtype = compute_dtype(m)
+    stage_fn = _make_stage_fn(ids, tgt, m, ctx, cos, sin, s_idx, pp)
+    fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+    bwd_perm = [(i + 1, i) for i in range(pp - 1)]
+
+    def tick(carry, t):
+        ring, x_buf, g_buf, g_acc, nll_acc, cnt_acc = carry
+
+        # ---- forward unit: microbatch m_f advances one stage ----
+        df = t - s_idx
+        f_on = (df >= 0) & (df % 2 == 0) & (df < 2 * n_micro)
+        m_f = jnp.clip(df // 2, 0, n_micro - 1)
+        (y, nll), cnt = stage_fn(params, x_buf, m_f, f_on)
+        take = f_on & (s_idx == pp - 1)
+        nll_acc = nll_acc + jnp.where(take, nll, 0.0)
+        cnt_acc = cnt_acc + jnp.where(take, cnt, 0)
+        # Save this stage's *input* for the backward recompute. Guard the
+        # store: on non-forward ticks m_f aliases a possibly-live slot.
+        ring_new = lax.dynamic_update_index_in_dim(ring, x_buf, m_f % pp, 0)
+        ring = jnp.where(f_on, ring_new, ring)
+        y_send = lax.ppermute(y * f_on.astype(y.dtype), "pp", fwd_perm)
+
+        # ---- backward unit: microbatch m_b retreats one stage ----
+        db = t - 2 * (pp - 1) + s_idx
+        b_on = (db >= 0) & (db % 2 == 0) & (db < 2 * n_micro)
+        m_b = jnp.clip(db // 2, 0, n_micro - 1)
+        x_saved = lax.dynamic_index_in_dim(ring, m_b % pp, 0, keepdims=False)
+        _, vjp_fn, _ = jax.vjp(
+            lambda p, xb: stage_fn(p, xb, m_b, b_on), params, x_saved,
+            has_aux=True)
+        # Cotangents: g_buf arrived from stage s+1 (zeros at the last stage
+        # by ppermute's edge semantics — its y has no downstream consumer);
+        # the loss cotangent is 1 only where the last stage scored m_b. On
+        # non-backward ticks both cotangents are zero, so the VJP outputs
+        # are zero and need no masking.
+        g_nll = _vary_over(jnp.where(b_on & (s_idx == pp - 1), 1.0, 0.0),
+                           {"dp", "cp", "pp"})
+        g_params, g_x = vjp_fn((g_buf, g_nll))
+        g_acc = jax.tree.map(
+            lambda a, g: jnp.add(a, _cast_varying_like(g, a)), g_acc, g_params)
+        g_send = lax.ppermute(g_x, "pp", bwd_perm)
+
+        return (ring, y_send, g_send, g_acc, nll_acc, cnt_acc), None
+
+    x0 = jnp.zeros((mbs, s_local, m.hidden_size), dtype)
+    bufs = lax.pcast(
+        (jnp.zeros((pp,) + x0.shape, dtype), x0, x0,
+         jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        ("dp", "cp", "pp"), to="varying")
+    # Each grad-accumulator leaf varies over the data axes plus whatever its
+    # param already varies over (tp/pp shardings) — matching what the VJP
+    # emits each tick, so the scan carry type is stable.
+    g_zero = jax.tree.map(
+        lambda p: _vary_over(jnp.zeros_like(p),
+                             {"dp", "cp", "pp"} | set(jax.typeof(p).vma)),
+        params)
+    init = (bufs[0], bufs[1], bufs[2], g_zero, bufs[3], bufs[4])
+    (_, _, _, grads, nll_sum, cnt), _ = lax.scan(tick, init, jnp.arange(n_ticks))
+
+    nll_sum = lax.psum(nll_sum, "pp")
+    cnt = lax.psum(cnt, "pp")
+    return grads, nll_sum, cnt
 
 
 def sync_pp_replicated_grads(grads, specs):

@@ -20,6 +20,7 @@ import jax
 
 from picotron_tpu.checkpoint import CheckpointManager, load_hf_safetensors
 from picotron_tpu.config import Config, load_config, num_params
+from picotron_tpu.models.llama import pad_layers_for_pp
 from picotron_tpu.data import MicroBatchDataLoader
 from picotron_tpu.mesh import MeshEnv, multihost_initialize
 from picotron_tpu.parallel.api import init_sharded_state, make_train_step
@@ -31,14 +32,16 @@ from picotron_tpu.utils import (
 )
 
 
-def build_state(cfg: Config, menv: MeshEnv) -> tuple[TrainState, int, int]:
-    """(state, start_step, trained_tokens) — fresh init, HF weights, or
-    resume, in the reference's precedence (ref: train.py:174-215: materialize
-    weights, then load_checkpoint overrides)."""
+def build_state(cfg: Config, menv: MeshEnv) -> tuple[TrainState, int, int, dict]:
+    """(state, start_step, trained_tokens, ckpt_meta) — fresh init, HF
+    weights, or resume, in the reference's precedence (ref: train.py:174-215:
+    materialize weights, then load_checkpoint overrides)."""
     state = init_sharded_state(cfg, menv, jax.random.key(cfg.training.seed))
 
     if cfg.checkpoint.init_from_hf:
         params = load_hf_safetensors(cfg.checkpoint.init_from_hf, cfg.model)
+        params = pad_layers_for_pp(params, cfg.model.num_hidden_layers,
+                                   cfg.distributed.pp_size)
         shardings = param_shardings(cfg, menv.mesh)
         params = jax.tree.map(jax.device_put, params, shardings)
         state = TrainState(params=params, opt_state=state.opt_state,
@@ -47,11 +50,12 @@ def build_state(cfg: Config, menv: MeshEnv) -> tuple[TrainState, int, int]:
 
     if cfg.checkpoint.load_path:
         mgr = CheckpointManager(cfg, menv, directory=cfg.checkpoint.load_path)
-        state, tokens = mgr.restore(state)
+        state, meta = mgr.restore(state)
+        tokens = meta.get("trained_tokens", 0)
         log_print(f"resumed from {cfg.checkpoint.load_path} at step "
                   f"{int(state.step)} ({human_format(tokens)} tokens)")
-        return state, int(state.step), tokens
-    return state, 0, 0
+        return state, int(state.step), tokens, meta
+    return state, 0, 0, {}
 
 
 def main(argv=None) -> None:
@@ -85,7 +89,20 @@ def main(argv=None) -> None:
     )
 
     dl = MicroBatchDataLoader(cfg, menv)
-    state, start_step, trained_tokens = build_state(cfg, menv)
+    state, start_step, trained_tokens, ckpt_meta = build_state(cfg, menv)
+    if start_step > 0:
+        # Fast-forward the dataloader so resume does not replay consumed
+        # data (ADVICE r1). Checkpoints record the exact position; for ones
+        # that predate that, derive it from the step count and the
+        # tail-dropping epoch arithmetic.
+        dl_state = ckpt_meta.get("dataloader")
+        if dl_state is None:
+            steps_per_epoch = max(1, len(dl.source) // cfg.global_batch_size)
+            dl_state = {
+                "epoch": start_step // steps_per_epoch,
+                "cursor": (start_step % steps_per_epoch) * cfg.global_batch_size,
+            }
+        dl.set_state(dl_state)
     step_fn = make_train_step(cfg, menv)
     ckpt_mgr = (CheckpointManager(cfg, menv)
                 if cfg.checkpoint.save_frequency > 0 else None)
@@ -100,14 +117,22 @@ def main(argv=None) -> None:
         except Exception as e:  # wandb optional; zero-egress pods have none
             log_print(f"wandb unavailable ({e}); continuing without")
 
+    # Two stop conditions, whichever bites first: the step budget and the
+    # token budget (ref: the config's max_tokens field).
+    total_steps = t.total_train_steps
+    if t.max_tokens is not None:
+        remaining = max(0, t.max_tokens - trained_tokens)
+        total_steps = min(total_steps,
+                          start_step + -(-remaining // cfg.tokens_per_step))
+
     timer = StepTimer()
     last_logged_step = start_step
-    for step in range(start_step + 1, t.total_train_steps + 1):
+    for step in range(start_step + 1, total_steps + 1):
         batch = next(dl)
         state, loss = step_fn(state, batch)
         trained_tokens += cfg.tokens_per_step
 
-        if step % cfg.logging.log_frequency == 0 or step == t.total_train_steps:
+        if step % cfg.logging.log_frequency == 0 or step == total_steps:
             loss = float(jax.block_until_ready(loss))
             dt = timer.lap()
             steps_in_window = step - last_logged_step
@@ -125,11 +150,13 @@ def main(argv=None) -> None:
                                "trained_tokens": trained_tokens}, step=step)
 
         if ckpt_mgr is not None and step % cfg.checkpoint.save_frequency == 0:
-            path = ckpt_mgr.save(state, trained_tokens)
+            path = ckpt_mgr.save(state, trained_tokens,
+                                 dataloader_state=dl.state)
             log_print(f"saved checkpoint -> {path}")
 
     if ckpt_mgr is not None:
-        ckpt_mgr.save(state, trained_tokens)
+        ckpt_mgr.save(state, trained_tokens, dataloader_state=dl.state)
+    dl.close()
     if wandb_run is not None:
         wandb_run.finish()
     log_print("training done")

@@ -48,12 +48,14 @@ def test_save_restore_roundtrip(tmp_path):
     state, _ = step(state, batch_for(cfg, menv))
 
     mgr = CheckpointManager(cfg, menv)
-    mgr.save(state, trained_tokens=1234)
+    mgr.save(state, trained_tokens=1234,
+             dataloader_state={"epoch": 2, "cursor": 6})
     assert mgr.latest_step() == 1
 
     template = init_sharded_state(cfg, menv, jax.random.key(99))
-    restored, tokens = mgr.restore(template)
-    assert tokens == 1234
+    restored, meta = mgr.restore(template)
+    assert meta["trained_tokens"] == 1234
+    assert meta["dataloader"] == {"epoch": 2, "cursor": 6}
     assert int(restored.step) == 1
     np.testing.assert_array_equal(
         np.asarray(restored.params["embedding"]),

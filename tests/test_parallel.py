@@ -11,7 +11,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from picotron_tpu.config import Config, DistributedConfig, ModelConfig, TrainingConfig
 from picotron_tpu.mesh import MeshEnv
-from picotron_tpu.models.llama import init_params
+from picotron_tpu.models.llama import (
+    forward, init_params, pad_layers_for_pp, pp_layer_placement, unpad_layers,
+)
 from picotron_tpu.ops.losses import cross_entropy
 from picotron_tpu.parallel.api import init_sharded_state, make_train_step
 from picotron_tpu.parallel.tp import vocab_parallel_ce, vocab_parallel_embed
@@ -20,11 +22,12 @@ from picotron_tpu.train_step import init_train_state, make_train_step as make_si
 
 def tiny_cfg(**dist) -> Config:
     gas = dist.pop("gas", 2)
+    layers = dist.pop("layers", 4)
     return Config(
         distributed=DistributedConfig(**dist),
         # 8 q heads / 4 kv heads so GQA survives tp up to 4
         model=ModelConfig(dtype="float32", num_attention_heads=8,
-                          num_key_value_heads=4),
+                          num_key_value_heads=4, num_hidden_layers=layers),
         training=TrainingConfig(seq_length=32, micro_batch_size=2,
                                 gradient_accumulation_steps=gas,
                                 learning_rate=1e-3, remat=False),
@@ -89,9 +92,16 @@ def run_single(cfg_parallel, steps=3):
     dict(dp_size=2, cp_size=2, tp_size=2),
     dict(dp_size=2, cp_size=2, tp_size=2, cp_layout="contiguous"),
     dict(pp_size=2),
+    dict(pp_size=2, pp_engine="afab"),
     dict(dp_size=2, pp_size=2),
     dict(pp_size=2, tp_size=2),
     dict(pp_size=4, gas=4),
+    dict(pp_size=4, gas=4, pp_engine="afab"),
+    # uneven layer splits: 5 layers pad to 6/8 slots, remainder to early
+    # stages (ref: pipeline_parallel.py:42-51)
+    dict(pp_size=2, layers=5),
+    dict(pp_size=2, layers=5, pp_engine="afab"),
+    dict(pp_size=4, layers=5, gas=4, tp_size=2),
     dict(dp_size=2, pp_size=2, cp_size=2),
     dict(dp_size=2, pp_size=2, tp_size=2),
 ])
@@ -100,16 +110,60 @@ def test_layouts_match_single_device(dist):
     par_losses, par_state = run_parallel(cfg)
     ref_losses, ref_state = run_single(cfg)
     np.testing.assert_allclose(par_losses, ref_losses, rtol=2e-4, atol=2e-5)
+    par_params = unpad_layers(par_state.params, cfg.model.num_hidden_layers,
+                              cfg.distributed.pp_size)
     # Parameters after 3 updates agree. Tolerance note: Adam divides by
     # sqrt(v) which amplifies fp32 reduction-order differences between the
     # sharded and dense reductions during the first steps, so this is
     # necessarily looser than the loss check.
-    q_par = np.asarray(par_state.params["layers"]["q"])
+    q_par = np.asarray(par_params["layers"]["q"])
     q_ref = np.asarray(ref_state.params["layers"]["q"])
     np.testing.assert_allclose(q_par, q_ref, rtol=2e-2, atol=1e-3)
     emb_par = np.asarray(par_state.params["embedding"])
     emb_ref = np.asarray(ref_state.params["embedding"])
     np.testing.assert_allclose(emb_par, emb_ref, rtol=2e-2, atol=1e-3)
+
+
+def test_pp_layer_placement_remainder_to_early_stages():
+    # 5 layers on pp=4: stages get 2,1,1,1 (ref: pipeline_parallel.py:42-51)
+    padded, slots = pp_layer_placement(5, 4)
+    assert padded == 8
+    assert slots.tolist() == [0, 1, 2, 4, 6]  # per-stage leading slots
+    padded, slots = pp_layer_placement(4, 2)  # even split: canonical
+    assert padded == 4 and slots.tolist() == [0, 1, 2, 3]
+
+
+def test_zero_padded_layers_are_identity():
+    """The uneven-PP padding contract: all-zero layer slots change neither
+    the forward values nor any real parameter's gradient."""
+    from picotron_tpu.ops.losses import cross_entropy
+
+    cfg = ModelConfig(dtype="float32", num_hidden_layers=5,
+                      num_attention_heads=8, num_key_value_heads=4)
+    params = init_params(cfg, jax.random.key(0))
+    padded = pad_layers_for_pp(params, 5, 2)
+    assert padded["layers"]["q"].shape[0] == 6
+    ids = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
+    tgt = jax.random.randint(jax.random.key(2), (2, 16), 0, cfg.vocab_size)
+    np.testing.assert_allclose(
+        np.asarray(forward(params, ids, cfg)),
+        np.asarray(forward(padded, ids, cfg)), rtol=1e-6)
+
+    def loss(p):
+        return cross_entropy(forward(p, ids, cfg), tgt)
+
+    g_pad = jax.grad(loss)(padded)
+    g_ref = jax.grad(loss)(params)
+    # pad slots get exactly-zero grads; real slots match the unpadded grads
+    jax.tree.map(
+        lambda gp, gr: np.testing.assert_allclose(
+            np.asarray(unpad_layers({"layers": {"x": gp}}, 5, 2)["layers"]["x"]),
+            np.asarray(gr), rtol=1e-5, atol=1e-7),
+        g_pad["layers"], g_ref["layers"])
+    slots_set = set(pp_layer_placement(5, 2)[1].tolist())
+    pad_slots = [i for i in range(6) if i not in slots_set]
+    for leaf in jax.tree.leaves(g_pad["layers"]):
+        assert np.all(np.asarray(leaf)[pad_slots] == 0.0)
 
 
 def test_vocab_parallel_embed_matches_lookup():
