@@ -125,17 +125,25 @@ class CheckpointManager:
         if src_m.get("num_hidden_layers") and src_d.get("pp_size"):
             from picotron_tpu.models.llama import pp_layer_placement
 
-            src_padded, _ = pp_layer_placement(
+            import numpy as np
+
+            src_padded, src_slots = pp_layer_placement(
                 src_m["num_hidden_layers"], src_d["pp_size"])
-            tmpl_padded = jax.tree.leaves(
-                state_template.params["layers"])[0].shape[0]
-            if src_padded != tmpl_padded:
+            dst_padded, dst_slots = pp_layer_placement(
+                self.cfg.model.num_hidden_layers,
+                self.cfg.distributed.pp_size)
+            # Padded sizes alone can collide across pp_sizes (10 layers on
+            # pp=3 and pp=4 both pad to 12) while placing real layers in
+            # different slots — compare the slot layout itself.
+            if src_padded != dst_padded or not np.array_equal(src_slots,
+                                                              dst_slots):
                 raise ValueError(
                     f"checkpoint was saved with an uneven PP layer split "
-                    f"(padded stack {src_padded}, pp={src_d['pp_size']}); "
-                    f"restoring into padded stack {tmpl_padded} is not "
-                    f"supported — resume with the same pp_size or use a "
-                    f"layer count divisible by both"
+                    f"(padded stack {src_padded}, pp={src_d['pp_size']}) "
+                    f"whose layer slots differ from this run's (padded "
+                    f"stack {dst_padded}, pp="
+                    f"{self.cfg.distributed.pp_size}); resume with the "
+                    f"same pp_size or use a layer count divisible by both"
                 )
         template = {
             "params": state_template.params,

@@ -288,6 +288,10 @@ class Config:
         if t.remat_policy not in ("full", "dots"):
             raise ValueError(
                 f"remat_policy must be 'full' or 'dots', got {t.remat_policy!r}")
+        if t.adam_moments_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"adam_moments_dtype must be 'float32' or 'bfloat16', got "
+                f"{t.adam_moments_dtype!r}")
         if t.seq_length < 1:
             raise ValueError(f"seq_length must be >= 1, got {t.seq_length}")
         if t.seq_length % d.cp_size != 0:

@@ -35,6 +35,13 @@ import numpy as np
 from picotron_tpu.config import Config
 
 
+class _ProducerError:
+    """Wrapper shipping a prefetch-thread exception through the batch queue."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 def cp_sequence_permutation(cfg: Config):
     """Permutation applied to the sequence axis before the P('cp') sharding,
     or None for the identity (contiguous) layout.
@@ -265,13 +272,21 @@ class MicroBatchDataLoader:
 
     def _produce(self):
         while not self._stop.is_set():
-            item = self._assemble_next()
+            try:
+                item = self._assemble_next()
+            except BaseException as e:  # noqa: BLE001 — relayed to consumer
+                # A dead producer must not leave the consumer blocked on an
+                # empty queue forever; ship the exception as an item and let
+                # __next__ re-raise it on the training thread.
+                item = _ProducerError(e)
             while not self._stop.is_set():
                 try:
                     self._queue.put(item, timeout=0.5)
                     break
                 except queue_mod.Full:
                     continue
+            if isinstance(item, _ProducerError):
+                return
 
     def close(self) -> None:
         if self._queue is not None:
@@ -285,7 +300,11 @@ class MicroBatchDataLoader:
                 self._thread = threading.Thread(target=self._produce,
                                                 daemon=True)
                 self._thread.start()
-            batch, post_state = self._queue.get()
+            got = self._queue.get()
+            if isinstance(got, _ProducerError):
+                raise RuntimeError(
+                    "dataloader prefetch thread died") from got.exc
+            batch, post_state = got
         else:
             batch, post_state = self._assemble_next()
         self._consumed_state = post_state

@@ -154,7 +154,10 @@ def main(argv=None) -> None:
                                  dataloader_state=dl.state)
             log_print(f"saved checkpoint -> {path}")
 
-    if ckpt_mgr is not None:
+    # Final save, unless this exact step is already on disk (a resumed run
+    # whose budget was met trains zero steps; re-saving the loaded step into
+    # its existing directory would make Orbax fail an otherwise-clean exit).
+    if ckpt_mgr is not None and ckpt_mgr.latest_step() != int(state.step):
         ckpt_mgr.save(state, trained_tokens, dataloader_state=dl.state)
     dl.close()
     if wandb_run is not None:
