@@ -35,12 +35,21 @@ def main() -> None:
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--no-remat", action="store_true")
     ap.add_argument("--remat-policy", default="dots", choices=["full", "dots"])
+    ap.add_argument("--adam-moments-dtype", default="bfloat16",
+                    choices=["float32", "bfloat16"],
+                    help="bf16 moments halve optimizer-state HBM traffic "
+                         "(profiled at ~9%% of step time fp32) and memory")
     ap.add_argument("--layers", type=int, default=None,
                     help="override the preset's layer count (bench a "
                          "depth-reduced variant of a big model); pass 0 "
                          "for the preset's full depth. Defaults to 8 for "
                          "the default SmolLM-1.7B only, full depth for any "
                          "explicitly chosen model")
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="capture a jax.profiler trace of the timed steps "
+                         "into DIR (open with xprof/tensorboard; see "
+                         "README 'Profiling'). SURVEY.md §5 prescribes "
+                         "profiler traces as the TPU observability story.")
     args = ap.parse_args()
 
     from picotron_tpu.config import (
@@ -68,6 +77,7 @@ def main() -> None:
             gradient_accumulation_steps=args.grad_acc,
             remat=not args.no_remat,
             remat_policy=args.remat_policy,
+            adam_moments_dtype=args.adam_moments_dtype,
         ),
     )
     cfg.validate()
@@ -96,11 +106,15 @@ def main() -> None:
     # ~100ms/step over a remote-tunnel backend). block_until_ready is NOT
     # trustworthy here — with donated (aliased) state buffers it can return
     # before the execution chain has run; a value fetch cannot lie.
+    if args.profile:
+        jax.profiler.start_trace(args.profile)
     t0 = time.perf_counter()
     for _ in range(args.steps):
         state, loss = step(state, batch)
     final_loss = float(loss)
     dt = time.perf_counter() - t0
+    if args.profile:
+        jax.profiler.stop_trace()
 
     tokens_per_step = b_global * args.grad_acc * args.seq
     tokens_per_sec = tokens_per_step * args.steps / dt

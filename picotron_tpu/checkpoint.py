@@ -125,8 +125,6 @@ class CheckpointManager:
         if src_m.get("num_hidden_layers") and src_d.get("pp_size"):
             from picotron_tpu.models.llama import pp_layer_placement
 
-            import numpy as np
-
             src_padded, src_slots = pp_layer_placement(
                 src_m["num_hidden_layers"], src_d["pp_size"])
             dst_padded, dst_slots = pp_layer_placement(

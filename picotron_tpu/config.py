@@ -249,6 +249,13 @@ class LoggingConfig:
     project_name: str = "picotron-tpu"
     run_name: Optional[str] = None
     log_frequency: int = 1
+    # jax.profiler trace capture (SURVEY.md §5: the reference has no
+    # profiler story; on TPU xprof traces are how compute/collective
+    # overlap is verified). None disables; a directory enables capture of
+    # steps [profile_start_step, profile_start_step + profile_num_steps).
+    profile_dir: Optional[str] = None
+    profile_start_step: int = 3
+    profile_num_steps: int = 3
 
 
 @dataclass(frozen=True)
@@ -292,6 +299,16 @@ class Config:
             raise ValueError(
                 f"adam_moments_dtype must be 'float32' or 'bfloat16', got "
                 f"{t.adam_moments_dtype!r}")
+        lg = self.logging
+        if lg.profile_dir is not None:
+            if lg.profile_start_step < 1:
+                raise ValueError(
+                    f"profile_start_step must be >= 1 (steps are 1-based), "
+                    f"got {lg.profile_start_step}")
+            if lg.profile_num_steps < 1:
+                raise ValueError(
+                    f"profile_num_steps must be >= 1, got "
+                    f"{lg.profile_num_steps}")
         if t.seq_length < 1:
             raise ValueError(f"seq_length must be >= 1, got {t.seq_length}")
         if t.seq_length % d.cp_size != 0:

@@ -204,6 +204,7 @@ class MicroBatchDataLoader:
         self._consumed_state = {"epoch": 0, "cursor": 0}
         self._prefetch_depth = cfg.dataset.num_workers
         self._queue = None  # created lazily on first __next__
+        self._producer_exc = None  # set once the prefetch thread dies
 
     # -- resume position (persisted in checkpoint meta; ADVICE r1) --------
 
@@ -300,8 +301,14 @@ class MicroBatchDataLoader:
                 self._thread = threading.Thread(target=self._produce,
                                                 daemon=True)
                 self._thread.start()
+            if self._producer_exc is not None:  # producer already dead
+                raise RuntimeError(
+                    "dataloader prefetch thread died") from self._producer_exc
             got = self._queue.get()
             if isinstance(got, _ProducerError):
+                # remember it: the thread has exited, so every later call
+                # must fail loudly too instead of blocking on an empty queue
+                self._producer_exc = got.exc
                 raise RuntimeError(
                     "dataloader prefetch thread died") from got.exc
             batch, post_state = got

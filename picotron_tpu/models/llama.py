@@ -34,7 +34,6 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax.ad_checkpoint import checkpoint_name
 
 from picotron_tpu.config import ModelConfig
 from picotron_tpu.ops.attention import sdpa_attention
@@ -241,10 +240,9 @@ def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
     # K/V stay unexpanded (n_kv heads) — attention impls handle GQA so the
     # CP ring permutes and flash streams the small K/V.
     out = ctx.attn(q, k, v, ctx.positions)  # [B, S, n_q, D]
-    # Named so the "dots" remat policy can save it: the Pallas kernel isn't
-    # a dot_general at the jaxpr level, so without the name the whole flash
-    # forward would be recomputed during backward.
-    out = checkpoint_name(out, "attn_out")
+    # attn_out/attn_lse are checkpoint_name'd inside each attention impl
+    # (flash VJP fwd rule / sdpa), so the "dots" remat policy saves the
+    # kernel residuals exactly once and backward never re-runs the forward.
     out = out.reshape(b, s, n_q * d)
     out = out @ lp["o"].astype(dt)
     return ctx.g(out)  # row-parallel exit: psum-over-tp fwd / identity bwd
@@ -276,9 +274,13 @@ def remat_policy_for(name: str):
     (parallel/pp.py) so both paths honor the same config knob.
     """
     if name == "dots":
+        # attn_lse rides along with attn_out (named inside the flash VJP's
+        # fwd rule, ops/flash_attention.py) so the kernel's residuals are
+        # fully saved and backward never re-runs the forward kernel.
         return jax.checkpoint_policies.save_from_both_policies(
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            jax.checkpoint_policies.save_only_these_names("attn_out"),
+            jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "attn_lse"),
         )
     return None
 

@@ -14,6 +14,7 @@ same reason).
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 
 def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
@@ -81,7 +82,11 @@ def sdpa_attention(
     l_safe = jnp.where(l == 0.0, 1.0, l)
     out = jnp.einsum("bhqk,bkhd->bqhd", (p / l_safe).astype(v.dtype), v,
                      preferred_element_type=jnp.float32).astype(q.dtype)
+    # Named so the "dots" remat policy saves the attention output on this
+    # reference path too (the flash path names its outputs inside the VJP
+    # fwd rule — ops/flash_attention.py — so each impl names exactly once).
+    out = checkpoint_name(out, "attn_out")
     if return_lse:
         lse = jnp.where(l == 0.0, -jnp.inf, m_safe + jnp.log(l_safe)).squeeze(-1)
-        return out, lse  # lse: [B, H, Sq] fp32
+        return out, checkpoint_name(lse, "attn_lse")  # lse: [B, H, Sq] fp32
     return out

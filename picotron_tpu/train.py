@@ -127,10 +127,24 @@ def main(argv=None) -> None:
 
     timer = StepTimer()
     last_logged_step = start_step
+    # Steps whose checkpoint is already on disk: the loaded step on resume,
+    # plus whatever this run saves below.
+    saved_steps = {start_step} if cfg.checkpoint.load_path else set()
+    prof = cfg.logging  # trace capture window (config.py LoggingConfig)
+    tracing = False
     for step in range(start_step + 1, total_steps + 1):
+        if prof.profile_dir and step - start_step == prof.profile_start_step:
+            jax.profiler.start_trace(prof.profile_dir)
+            tracing = True
         batch = next(dl)
         state, loss = step_fn(state, batch)
         trained_tokens += cfg.tokens_per_step
+        if (tracing and step - start_step
+                >= prof.profile_start_step + prof.profile_num_steps - 1):
+            jax.block_until_ready(loss)
+            jax.profiler.stop_trace()
+            tracing = False
+            log_print(f"profiler trace -> {prof.profile_dir}")
 
         if step % cfg.logging.log_frequency == 0 or step == total_steps:
             loss = float(jax.block_until_ready(loss))
@@ -152,12 +166,19 @@ def main(argv=None) -> None:
         if ckpt_mgr is not None and step % cfg.checkpoint.save_frequency == 0:
             path = ckpt_mgr.save(state, trained_tokens,
                                  dataloader_state=dl.state)
+            saved_steps.add(step)
             log_print(f"saved checkpoint -> {path}")
 
-    # Final save, unless this exact step is already on disk (a resumed run
-    # whose budget was met trains zero steps; re-saving the loaded step into
-    # its existing directory would make Orbax fail an otherwise-clean exit).
-    if ckpt_mgr is not None and ckpt_mgr.latest_step() != int(state.step):
+    if tracing:  # run ended inside the capture window — close cleanly
+        jax.profiler.stop_trace()
+        log_print(f"profiler trace -> {prof.profile_dir}")
+
+    # Final save, unless this run already wrote this exact step (a resumed
+    # run whose budget was met trains zero steps; re-saving the loaded step
+    # into its existing directory would make Orbax fail an otherwise-clean
+    # exit). Tracked in-process so a stale same-numbered checkpoint from an
+    # earlier run into the same save_dir cannot suppress the save.
+    if ckpt_mgr is not None and int(state.step) not in saved_steps:
         ckpt_mgr.save(state, trained_tokens, dataloader_state=dl.state)
     dl.close()
     if wandb_run is not None:
