@@ -53,7 +53,12 @@ def _identity(x):
     return x
 
 
-def _default_attn(q, k, v, positions):
+def _default_attn(q, k, v, positions, rope):
+    # q/k arrive unrotated: each attention impl owns RoPE so the flash path
+    # can rotate inside its kernels (parallel/api.py) while reference paths
+    # use the jnp rotation.
+    q = apply_rope(q, *rope, positions)
+    k = apply_rope(k, *rope, positions)
     return sdpa_attention(q, k, v, causal=True,
                           q_positions=positions, kv_positions=positions)
 
@@ -235,11 +240,10 @@ def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
     k = k.reshape(b, s, n_kv, d)
     v = v.reshape(b, s, n_kv, d)
 
-    q = apply_rope(q, cos, sin, ctx.positions)
-    k = apply_rope(k, cos, sin, ctx.positions)
     # K/V stay unexpanded (n_kv heads) — attention impls handle GQA so the
-    # CP ring permutes and flash streams the small K/V.
-    out = ctx.attn(q, k, v, ctx.positions)  # [B, S, n_q, D]
+    # CP ring permutes and flash streams the small K/V. RoPE is applied by
+    # the impl (in-kernel on the flash path), so q/k pass through raw.
+    out = ctx.attn(q, k, v, ctx.positions, (cos, sin))  # [B, S, n_q, D]
     # attn_out/attn_lse are checkpoint_name'd inside each attention impl
     # (flash VJP fwd rule / sdpa), so the "dots" remat policy saves the
     # kernel residuals exactly once and backward never re-runs the forward.

@@ -60,6 +60,33 @@ def _pick_block(s: int, preferred: int) -> int:
     return max(b, 1)
 
 
+def _rot_tables(cos, sin, pos, dtype=jnp.float32):
+    """Gather the half tables [maxS, d/2] at `pos` [1, S] and lay them out
+    full-width for the in-kernel rotate-half:
+
+        rot(x)     = x * C + roll(x, d/2) * S,   C = [cos|cos], S = [-sin|sin]
+        rot_inv(y) = y * C + roll(y, d/2) * (-S)
+
+    (roll moves the upper half down: roll(x)[: d/2] = x2, matching the HF
+    rotate_half convention rot(x) = x*cos_full + [-x2|x1]*sin_full.)"""
+    c = cos[pos[0]].astype(dtype)                    # [S, d/2]
+    s = sin[pos[0]].astype(dtype)
+    C = jnp.concatenate([c, c], axis=-1)[None]       # [1, S, d]
+    S = jnp.concatenate([-s, s], axis=-1)[None]
+    return C, S
+
+
+def _rot(x, c_ref, s_ref, sign: float):
+    """Rotate an [N, d] tile with full-width tables from `_rot_tables`;
+    sign=+1 applies RoPE, sign=-1 its inverse (transpose). fp32 math, result
+    cast back to x.dtype so the MXU stays on the bf16 path."""
+    xf = x.astype(jnp.float32)
+    half = x.shape[-1] // 2
+    rolled = jnp.concatenate([xf[:, half:], xf[:, :half]], axis=-1)
+    out = xf * c_ref[0] + rolled * (sign * s_ref[0])
+    return out.astype(x.dtype)
+
+
 def _out_struct(shape, dtype, *operands):
     """ShapeDtypeStruct whose `vma` is the union of the operands' varying
     mesh axes — required for pallas_call under shard_map(check_vma=True)
@@ -75,9 +102,15 @@ def _out_struct(shape, dtype, *operands):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_ref, l_ref, acc_ref, *, sm_scale: float, causal: bool,
-                num_kv: int):
+def _fwd_kernel(*refs, sm_scale: float, causal: bool, num_kv: int,
+                fused_rope: bool):
+    if fused_rope:
+        (qpos_ref, kpos_ref, cq_ref, sq_ref, ck_ref, sk_ref,
+         q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+         qrot_ref) = refs
+    else:
+        (qpos_ref, kpos_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_ref, l_ref, acc_ref) = refs
     ki = pl.program_id(3)
 
     @pl.when(ki == 0)
@@ -85,6 +118,11 @@ def _fwd_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
+        if fused_rope:
+            # q is constant across the sequential kv dim — rotate once per
+            # q-block chain, not once per kv block (the rotation lands on
+            # the VPU, this kernel's bottleneck unit).
+            qrot_ref[...] = _rot(q_ref[0, 0], cq_ref, sq_ref, 1.0)
 
     qpos = qpos_ref[0]                                       # [BQ]
     kpos = kpos_ref[0]                                       # [BK]
@@ -105,8 +143,12 @@ def _fwd_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         # accumulation via preferred_element_type; only the softmax math runs
         # in fp32. Casting inputs to fp32 before the dot would put the MXU in
         # fp32 mode (~8x slower on MXU).
-        q = q_ref[0, 0]                                      # [BQ, D]
-        k_blk = k_ref[0, 0]                                  # [BK, D]
+        if fused_rope:
+            q = qrot_ref[...]                                # [BQ, D]
+            k_blk = _rot(k_ref[0, 0], ck_ref, sk_ref, 1.0)
+        else:
+            q = q_ref[0, 0]                                  # [BQ, D]
+            k_blk = k_ref[0, 0]                              # [BK, D]
         v_blk = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
@@ -154,9 +196,10 @@ def _fwd_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = lse.astype(jnp.float32)[:, None]
 
 
-def _fwd(q4, k4, v4, qpos, kpos, sm_scale, causal, block_q, block_k,
+def _fwd(q4, k4, v4, qpos, kpos, rope, sm_scale, causal, block_q, block_k,
          interpret):
-    """q4 [B,Hq,Sq,D]; k4/v4 [B,Hkv,Sk,D]; qpos [1,Sq]; kpos [1,Sk]."""
+    """q4 [B,Hq,Sq,D]; k4/v4 [B,Hkv,Sk,D]; qpos [1,Sq]; kpos [1,Sk];
+    rope = None or (cos, sin) half tables [maxS, D/2] applied in-kernel."""
     b, hq, sq, d = q4.shape
     hkv, sk = k4.shape[1], k4.shape[2]
     n_rep = hq // hkv
@@ -164,15 +207,29 @@ def _fwd(q4, k4, v4, qpos, kpos, sm_scale, causal, block_q, block_k,
     bk = _pick_block(sk, block_k)
     num_kv = sk // bk
 
+    rope_args, rope_specs = [], []
+    if rope is not None:
+        cq, sq_t = _rot_tables(*rope, qpos)
+        ck, sk_t = _rot_tables(*rope, kpos)
+        rope_args = [cq, sq_t, ck, sk_t]
+        rope_specs = [
+            pl.BlockSpec((1, bq, d), lambda bi, hi, qi, ki: (0, qi, 0)),
+            pl.BlockSpec((1, bq, d), lambda bi, hi, qi, ki: (0, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bi, hi, qi, ki: (0, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bi, hi, qi, ki: (0, ki, 0)),
+        ]
+
     grid = (b, hq, sq // bq, num_kv)
     kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal, num_kv=num_kv)
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, num_kv=num_kv,
+        fused_rope=rope is not None)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq), lambda bi, hi, qi, ki: (0, qi)),  # qpos
             pl.BlockSpec((1, bk), lambda bi, hi, qi, ki: (0, ki)),  # kpos
+            *rope_specs,
             pl.BlockSpec((1, 1, bq, d),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, bk, d),
@@ -187,19 +244,22 @@ def _fwd(q4, k4, v4, qpos, kpos, sm_scale, causal, block_q, block_k,
             pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ],
         out_shape=[
-            _out_struct((b, hq, sq, d), q4.dtype, q4, k4, v4, qpos, kpos),
-            _out_struct((b, hq, sq, 1), jnp.float32, q4, k4, v4, qpos, kpos),
+            _out_struct((b, hq, sq, d), q4.dtype, q4, k4, v4, qpos, kpos,
+                        *rope_args),
+            _out_struct((b, hq, sq, 1), jnp.float32, q4, k4, v4, qpos, kpos,
+                        *rope_args),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),   # m (broadcast over lanes)
             pltpu.VMEM((bq, 128), jnp.float32),   # l
             pltpu.VMEM((bq, d), jnp.float32),     # acc
-        ],
+        ] + ([pltpu.VMEM((bq, d), q4.dtype)]      # rotated q, reused per ki
+             if rope is not None else []),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(qpos, kpos, q4, k4, v4)
+    )(qpos, kpos, *rope_args, q4, k4, v4)
     return out, lse
 
 
@@ -208,14 +268,22 @@ def _fwd(q4, k4, v4, qpos, kpos, sm_scale, causal, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                   delta_ref, dq_ref, dq_acc_ref, *, sm_scale: float,
-                   causal: bool, num_kv: int):
+def _bwd_dq_kernel(*refs, sm_scale: float, causal: bool, num_kv: int,
+                   fused_rope: bool):
+    if fused_rope:
+        (qpos_ref, kpos_ref, cq_ref, sq_ref, ck_ref, sk_ref,
+         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc_ref, qrot_ref) = refs
+    else:
+        (qpos_ref, kpos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+         delta_ref, dq_ref, dq_acc_ref) = refs
     ki = pl.program_id(3)
 
     @pl.when(ki == 0)
     def _init():
         dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+        if fused_rope:
+            qrot_ref[...] = _rot(q_ref[0, 0], cq_ref, sq_ref, 1.0)
 
     qpos = qpos_ref[0]
     kpos = kpos_ref[0]
@@ -228,12 +296,16 @@ def _bwd_dq_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     def _tile(masked: bool):
         # bf16 MXU matmuls with fp32 accumulation (see _fwd_kernel note).
-        q = q_ref[0, 0]                                      # [BQ, D]
         do = do_ref[0, 0]
         lse = lse_ref[0, 0, :, 0]                            # [BQ]
         delta = delta_ref[0, 0, :, 0]                        # [BQ]
-        k_blk = k_ref[0, 0]                                  # [BK, D]
         v_blk = v_ref[0, 0]
+        if fused_rope:
+            q = qrot_ref[...]                                # [BQ, D]
+            k_blk = _rot(k_ref[0, 0], ck_ref, sk_ref, 1.0)
+        else:
+            q = q_ref[0, 0]                                  # [BQ, D]
+            k_blk = k_ref[0, 0]                              # [BK, D]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -267,12 +339,23 @@ def _bwd_dq_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(ki == num_kv - 1)
     def _finalize():
-        dq_ref[0, 0] = dq_acc_ref[...].astype(dq_ref.dtype)
+        dq = dq_acc_ref[...]
+        if fused_rope:
+            # dq was accumulated w.r.t. the rotated q; map back through the
+            # rotation's transpose (R^T = rotation with negated sin).
+            dq = _rot(dq, cq_ref, sq_ref, -1.0)
+        dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                    delta_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
-                    sm_scale: float, causal: bool, num_inner: int):
+def _bwd_dkv_kernel(*refs, sm_scale: float, causal: bool, num_inner: int,
+                    fused_rope: bool):
+    if fused_rope:
+        (qpos_ref, kpos_ref, cq_ref, sq_ref, ck_ref, sk_ref,
+         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, krot_ref) = refs
+    else:
+        (qpos_ref, kpos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+         delta_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref) = refs
     # Inner sequential dim folds (group-head, q-block): the GQA group
     # accumulates into this kv-head's dk/dv inside the program.
     t = pl.program_id(3)
@@ -281,6 +364,10 @@ def _bwd_dkv_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     def _init():
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+        if fused_rope:
+            # k is constant across the inner (group-head x q-block) dim —
+            # rotate once per kv-block chain.
+            krot_ref[...] = _rot(k_ref[0, 0], ck_ref, sk_ref, 1.0)
 
     qpos = qpos_ref[0]
     kpos = kpos_ref[0]
@@ -293,10 +380,14 @@ def _bwd_dkv_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     def _tile(masked: bool):
         # bf16 MXU matmuls with fp32 accumulation (see _fwd_kernel note).
-        k_blk = k_ref[0, 0]                                  # [BK, D]
         v_blk = v_ref[0, 0]
-        q_blk = q_ref[0, 0]                                  # [BQ, D]
         do = do_ref[0, 0]
+        if fused_rope:
+            k_blk = krot_ref[...]                            # [BK, D]
+            q_blk = _rot(q_ref[0, 0], cq_ref, sq_ref, 1.0)
+        else:
+            k_blk = k_ref[0, 0]                              # [BK, D]
+            q_blk = q_ref[0, 0]                              # [BQ, D]
         lse = lse_ref[0, 0, :, 0]
         delta = delta_ref[0, 0, :, 0]
         s = jax.lax.dot_general(
@@ -336,11 +427,14 @@ def _bwd_dkv_kernel(qpos_ref, kpos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(t == num_inner - 1)
     def _finalize():
-        dk_ref[0, 0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dk = dk_acc_ref[...]
+        if fused_rope:
+            dk = _rot(dk, ck_ref, sk_ref, -1.0)  # back through R^T
+        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
-def _bwd(q4, k4, v4, o4, lse, do4, dlse, qpos, kpos, sm_scale, causal,
+def _bwd(q4, k4, v4, o4, lse, do4, dlse, qpos, kpos, rope, sm_scale, causal,
          block_q, block_k, interpret):
     b, hq, sq, d = q4.shape
     hkv, sk = k4.shape[1], k4.shape[2]
@@ -349,6 +443,18 @@ def _bwd(q4, k4, v4, o4, lse, do4, dlse, qpos, kpos, sm_scale, causal,
     bk = _pick_block(sk, block_k)
     num_q = sq // bq
     num_kv = sk // bk
+
+    rope_args = []
+    if rope is not None:
+        cq, sq_t = _rot_tables(*rope, qpos)
+        ck, sk_t = _rot_tables(*rope, kpos)
+        rope_args = [cq, sq_t, ck, sk_t]
+
+    def rope_specs(qmap, kmap):
+        if rope is None:
+            return []
+        return [pl.BlockSpec((1, bq, d), qmap), pl.BlockSpec((1, bq, d), qmap),
+                pl.BlockSpec((1, bk, d), kmap), pl.BlockSpec((1, bk, d), kmap)]
 
     # delta = rowsum(do * o) [B, Hq, Sq] (flash-attn 2's D term). The LSE
     # cotangent folds in here: dL/ds_ij = p_ij * (dp_ij - delta_i + dlse_i)
@@ -361,11 +467,13 @@ def _bwd(q4, k4, v4, o4, lse, do4, dlse, qpos, kpos, sm_scale, causal,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          num_kv=num_kv),
+                          num_kv=num_kv, fused_rope=rope is not None),
         grid=(b, hq, num_q, num_kv),
         in_specs=[
             pl.BlockSpec((1, bq), lambda bi, hi, qi, ki: (0, qi)),
             pl.BlockSpec((1, bk), lambda bi, hi, qi, ki: (0, ki)),
+            *rope_specs(lambda bi, hi, qi, ki: (0, qi, 0),
+                        lambda bi, hi, qi, ki: (0, ki, 0)),
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, bk, d),
                          lambda bi, hi, qi, ki, n_rep=n_rep:
@@ -380,13 +488,15 @@ def _bwd(q4, k4, v4, o4, lse, do4, dlse, qpos, kpos, sm_scale, causal,
         out_specs=pl.BlockSpec((1, 1, bq, d),
                                lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         out_shape=_out_struct((b, hq, sq, d), q4.dtype,
-                              q4, k4, v4, do4, lse, delta, qpos, kpos),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+                              q4, k4, v4, do4, lse, delta, qpos, kpos,
+                              *rope_args),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)]
+        + ([pltpu.VMEM((bq, d), q4.dtype)] if rope is not None else []),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(qpos, kpos, q4, k4, v4, do4, lse, delta)
+    )(qpos, kpos, *rope_args, q4, k4, v4, do4, lse, delta)
 
     # dk/dv: one program per (batch, KV head, kv-block); the inner
     # sequential dim walks the group's query heads x q-blocks, accumulating
@@ -401,11 +511,13 @@ def _bwd(q4, k4, v4, o4, lse, do4, dlse, qpos, kpos, sm_scale, causal,
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          num_inner=num_inner),
+                          num_inner=num_inner, fused_rope=rope is not None),
         grid=(b, hkv, num_kv, num_inner),
         in_specs=[
             pl.BlockSpec((1, bq), lambda bi, hi, ki, t: (0, qblk(t))),
             pl.BlockSpec((1, bk), lambda bi, hi, ki, t: (0, ki)),
+            *rope_specs(lambda bi, hi, ki, t: (0, qblk(t), 0),
+                        lambda bi, hi, ki, t: (0, ki, 0)),
             pl.BlockSpec((1, 1, bq, d),
                          lambda bi, hi, ki, t: (bi, qhead(hi, t), qblk(t), 0)),
             pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, t: (bi, hi, ki, 0)),
@@ -423,19 +535,20 @@ def _bwd(q4, k4, v4, o4, lse, do4, dlse, qpos, kpos, sm_scale, causal,
         ],
         out_shape=[
             _out_struct((b, hkv, sk, d), k4.dtype,
-                        q4, k4, v4, do4, lse, delta, qpos, kpos),
+                        q4, k4, v4, do4, lse, delta, qpos, kpos, *rope_args),
             _out_struct((b, hkv, sk, d), v4.dtype,
-                        q4, k4, v4, do4, lse, delta, qpos, kpos),
+                        q4, k4, v4, do4, lse, delta, qpos, kpos, *rope_args),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
-        ],
+        ] + ([pltpu.VMEM((bk, d), k4.dtype)]  # rotated k, reused per t
+             if rope is not None else []),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
-    )(qpos, kpos, q4, k4, v4, do4, lse, delta)
+    )(qpos, kpos, *rope_args, q4, k4, v4, do4, lse, delta)
 
     return dq, dk.astype(k4.dtype), dv.astype(v4.dtype)
 
@@ -445,16 +558,16 @@ def _bwd(q4, k4, v4, o4, lse, do4, dlse, qpos, kpos, sm_scale, causal,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
-def _flash_core(q4, k4, v4, qpos, kpos, sm_scale, causal, block_q, block_k,
-                interpret):
-    return _fwd(q4, k4, v4, qpos, kpos, sm_scale, causal, block_q, block_k,
-                interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _flash_core(q4, k4, v4, qpos, kpos, rope, sm_scale, causal, block_q,
+                block_k, interpret):
+    return _fwd(q4, k4, v4, qpos, kpos, rope, sm_scale, causal, block_q,
+                block_k, interpret)
 
 
-def _flash_core_fwd(q4, k4, v4, qpos, kpos, sm_scale, causal, block_q,
+def _flash_core_fwd(q4, k4, v4, qpos, kpos, rope, sm_scale, causal, block_q,
                     block_k, interpret):
-    out, lse = _fwd(q4, k4, v4, qpos, kpos, sm_scale, causal, block_q,
+    out, lse = _fwd(q4, k4, v4, qpos, kpos, rope, sm_scale, causal, block_q,
                     block_k, interpret)
     # Residuals carry the *named* values: under jax.checkpoint the "dots"
     # policy (models/llama.py remat_policy_for) saves attn_out/attn_lse, so
@@ -462,15 +575,18 @@ def _flash_core_fwd(q4, k4, v4, qpos, kpos, sm_scale, causal, block_q,
     # (profiled at ~4% of step time as rematted_computation).
     out = checkpoint_name(out, "attn_out")
     lse = checkpoint_name(lse, "attn_lse")
-    return (out, lse), (q4, k4, v4, out, lse, qpos, kpos)
+    return (out, lse), (q4, k4, v4, out, lse, qpos, kpos, rope)
 
 
 def _flash_core_bwd(sm_scale, causal, block_q, block_k, interpret, res, cts):
-    q4, k4, v4, out, lse, qpos, kpos = res
+    q4, k4, v4, out, lse, qpos, kpos, rope = res
     do4, dlse = cts
-    dq, dk, dv = _bwd(q4, k4, v4, out, lse, do4, dlse, qpos, kpos, sm_scale,
-                      causal, block_q, block_k, interpret)
-    return dq, dk, dv, None, None
+    dq, dk, dv = _bwd(q4, k4, v4, out, lse, do4, dlse, qpos, kpos, rope,
+                      sm_scale, causal, block_q, block_k, interpret)
+    # rope tables get a zero cotangent (they are precomputed position
+    # constants, never trained).
+    drope = None if rope is None else jax.tree.map(jnp.zeros_like, rope)
+    return dq, dk, dv, None, None, drope
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -486,6 +602,7 @@ def flash_attention(
     kv_positions: Optional[jnp.ndarray] = None,
     return_lse: bool = False,
     sm_scale: Optional[float] = None,
+    rope: Optional[tuple] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
@@ -493,6 +610,12 @@ def flash_attention(
     """Drop-in flash counterpart of `sdpa_attention` (same shapes/semantics):
     q [B, Sq, Hq, D]; k/v [B, Sk, Hkv, D] (GQA unexpanded); optional global
     position vectors for CP shards. Returns out (and fp32 lse [B, Hq, Sq]).
+
+    rope: optional (cos, sin) half tables [maxS, D/2] from ops.rope — when
+    given, q/k arrive UNROTATED and rotate-half RoPE is applied inside the
+    kernels at q_positions/kv_positions (replacing the reference's separate
+    fused-rotary CUDA kernel, ref: model.py:8,136-137, and XLA's layout-heavy
+    rotate-half, which profiled at ~7% of a train step).
 
     Backend dispatch: on TPU the Pallas kernels run compiled. On other
     backends (the simulated-mesh test platform) the mathematically identical
@@ -507,7 +630,11 @@ def flash_attention(
         sm_scale = 1.0 / (d ** 0.5)
     if interpret is None and jax.default_backend() != "tpu":
         from picotron_tpu.ops.attention import sdpa_attention
+        from picotron_tpu.ops.rope import apply_rope
 
+        if rope is not None:
+            q = apply_rope(q, *rope, q_positions)
+            k = apply_rope(k, *rope, kv_positions)
         return sdpa_attention(
             q, k, v, causal=causal, q_positions=q_positions,
             kv_positions=kv_positions, return_lse=return_lse,
@@ -527,7 +654,7 @@ def flash_attention(
     # S/BK of them, and for the common d = 4^k the scale 2^-k is exact in
     # bf16. Differentiable, so dq picks up the factor through the VJP chain.
     out, lse = _flash_core(q4 * jnp.asarray(sm_scale, q4.dtype), k4, v4,
-                           qpos, kpos, 1.0, causal, block_q,
+                           qpos, kpos, rope, 1.0, causal, block_q,
                            block_k, interpret)
     out = jnp.swapaxes(out, 1, 2)
     if return_lse:

@@ -73,25 +73,42 @@ def make_parallel_ctx(cfg: Config) -> ParallelCtx:
             "attn_impl='ring' requires cp_size > 1 (ring attention is the "
             "context-parallel schedule; ref: context_parallel.py:10-12)"
         )
-    if cfg.model.attn_impl in ("auto", "flash", "ring"):
+    use_flash = cfg.model.attn_impl in ("auto", "flash", "ring")
+    if use_flash:
         from picotron_tpu.ops.flash_attention import flash_attention as attn_fn
     else:
         from picotron_tpu.ops.attention import sdpa_attention as attn_fn
 
     if d.cp_size > 1:
         from picotron_tpu.ops.ring_attention import ring_attention
+        from picotron_tpu.ops.rope import apply_rope
 
         blockwise = partial(attn_fn, return_lse=True)
 
-        def attn(q, k, v, pos):
+        def attn(q, k, v, pos, rope):
             # positions are single-sourced here: RoPE and the ring's causal
-            # masking must see the same sequence layout (zigzag ordering, when
-            # it lands, changes `positions` in exactly one place).
+            # masking must see the same sequence layout (zigzag ordering
+            # changes `positions` in exactly one place). K/V are rotated
+            # BEFORE entering the ring so each block travels pre-rotated
+            # with its positions (ref: context_parallel.py:189-195).
+            q = apply_rope(q, *rope, pos)
+            k = apply_rope(k, *rope, pos)
             return ring_attention(q, k, v, axis="cp", q_positions=pos,
                                   attn_block=blockwise)
-    else:
+    elif use_flash:
 
-        def attn(q, k, v, pos):
+        def attn(q, k, v, pos, rope):
+            # RoPE fused into the Pallas kernels (rotation + un-rotation in
+            # VMEM) — XLA's rotate-half concat/slice chain profiled at ~7%
+            # of a train step.
+            return attn_fn(q, k, v, causal=True, rope=rope,
+                           q_positions=pos, kv_positions=pos)
+    else:
+        from picotron_tpu.ops.rope import apply_rope
+
+        def attn(q, k, v, pos, rope):
+            q = apply_rope(q, *rope, pos)
+            k = apply_rope(k, *rope, pos)
             return attn_fn(q, k, v, causal=True,
                            q_positions=pos, kv_positions=pos)
 

@@ -14,6 +14,7 @@ host runs ordinary Python and every collective lives inside the jitted step.
 from __future__ import annotations
 
 import argparse
+import os
 import time
 
 import jax
@@ -127,9 +128,14 @@ def main(argv=None) -> None:
 
     timer = StepTimer()
     last_logged_step = start_step
-    # Steps whose checkpoint is already on disk: the loaded step on resume,
-    # plus whatever this run saves below.
-    saved_steps = {start_step} if cfg.checkpoint.load_path else set()
+    # Steps whose checkpoint already exists in the SAVE directory: the loaded
+    # step counts only when load_path is the save dir (resuming in place) —
+    # resuming from elsewhere must still write a final save into save_dir.
+    resumed_in_place = (
+        cfg.checkpoint.load_path
+        and os.path.abspath(cfg.checkpoint.load_path)
+        == os.path.abspath(cfg.checkpoint.save_dir))
+    saved_steps = {start_step} if resumed_in_place else set()
     prof = cfg.logging  # trace capture window (config.py LoggingConfig)
     tracing = False
     for step in range(start_step + 1, total_steps + 1):

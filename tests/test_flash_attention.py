@@ -111,3 +111,44 @@ def test_bf16_kernel_close():
     want = sdpa_attention(q, k, v, causal=True).astype(jnp.float32)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=5e-2, atol=5e-2)
+
+
+# -- fused RoPE ------------------------------------------------------------
+
+
+def test_kernel_fused_rope_matches_unfused():
+    """rope=(cos, sin) rotates q/k inside the kernels; must equal jnp
+    apply_rope followed by the plain kernel — forward, lse, and all grads
+    (dq/dk exercise the in-kernel inverse rotation)."""
+    from picotron_tpu.ops.rope import apply_rope, rope_tables
+
+    q, k, v = qkv(s=128, hq=4, hkv=2)
+    cos, sin = rope_tables(256, q.shape[-1])
+    pos = jnp.arange(40, 168)  # shard-like offset positions
+
+    def loss_fused(q, k, v):
+        out, lse = flash_attention(
+            q, k, v, causal=True, rope=(cos, sin), q_positions=pos,
+            kv_positions=pos, return_lse=True, block_q=32, block_k=32,
+            interpret=True)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.where(jnp.isfinite(lse),
+                                                     lse, 0.0)), (out, lse)
+
+    def loss_ref(q, k, v):
+        qr = apply_rope(q, cos, sin, pos)
+        kr = apply_rope(k, cos, sin, pos)
+        out, lse = flash_attention(
+            qr, kr, v, causal=True, q_positions=pos, kv_positions=pos,
+            return_lse=True, block_q=32, block_k=32, interpret=True)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.where(jnp.isfinite(lse),
+                                                     lse, 0.0)), (out, lse)
+
+    gf, aux_f = jax.grad(loss_fused, (0, 1, 2), has_aux=True)(q, k, v)
+    gr, aux_r = jax.grad(loss_ref, (0, 1, 2), has_aux=True)(q, k, v)
+    np.testing.assert_allclose(np.asarray(aux_f[0]), np.asarray(aux_r[0]),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(aux_f[1]), np.asarray(aux_r[1]),
+                               rtol=2e-5, atol=2e-5)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4, err_msg=f"d{name}")

@@ -49,6 +49,29 @@ def main():
         print(f"d{n} maxdiff:",
               float(jnp.abs(x.astype(jnp.float32) - y.astype(jnp.float32)).max()))
 
+    # fused RoPE (rotation inside the kernels) vs jnp rotate + plain kernel
+    from picotron_tpu.ops.rope import apply_rope, rope_tables
+    cos, sin = rope_tables(s, d)
+
+    def fused(q, k, v):
+        return flash_attention(q, k, v, causal=True, rope=(cos, sin),
+                               interpret=False).astype(jnp.float32)
+
+    def unfused(q, k, v):
+        return flash_attention(apply_rope(q, cos, sin),
+                               apply_rope(k, cos, sin), v, causal=True,
+                               interpret=False).astype(jnp.float32)
+
+    got = jax.block_until_ready(jax.jit(fused)(q, k, v))
+    want = jax.block_until_ready(jax.jit(unfused)(q, k, v))
+    print("fused-rope fwd maxdiff:", float(jnp.abs(got - want).max()))
+    gfr = jax.jit(jax.grad(lambda *a: jnp.sum(fused(*a) ** 2), (0, 1, 2)))
+    gur = jax.jit(jax.grad(lambda *a: jnp.sum(unfused(*a) ** 2), (0, 1, 2)))
+    for x, y, n in zip(jax.block_until_ready(gfr(q, k, v)),
+                       jax.block_until_ready(gur(q, k, v)), "qkv"):
+        print(f"fused-rope d{n} maxdiff:",
+              float(jnp.abs(x.astype(jnp.float32) - y.astype(jnp.float32)).max()))
+
     def timeit(fn, n=20):
         jax.block_until_ready(fn(q, k, v))
         t0 = time.perf_counter()
