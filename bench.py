@@ -6,9 +6,14 @@ auxiliary fields). `vs_baseline` compares achieved MFU against the driver's
 north-star bar of 40% MFU (BASELINE.json; the reference reports ~50% MFU for
 SmolLM-1.7B on 8xH100 and 38% for Llama-2-7B on 64xH100, ref: README.md:7).
 
-Defaults are sized for a single TPU chip: SmolLM-360M, seq 2048, bf16
-compute over fp32 master params. On a multi-chip host it data-parallelizes
-over all local chips automatically.
+Defaults are sized for a single TPU chip: a depth-reduced SmolLM-1.7B, seq
+2048, bf16 compute over fp32 master params. On a multi-chip host it
+data-parallelizes over all local chips automatically.
+
+`--sweep` runs the breadth matrix instead (BASELINE.md asks for tokens/s/chip
++ MFU across configurations; DP x TP x PP x CP needs chips this host lacks,
+so the single-chip axes are model size / depth, sequence length, and batch):
+one JSON line per config, headline config last.
 """
 
 from __future__ import annotations
@@ -20,13 +25,115 @@ import time
 import jax
 import jax.numpy as jnp
 
+# (model, layers [None = preset depth], seq, mbs) — ordered so the headline
+# metric is the LAST line, keeping `python bench.py --sweep | tail -1`
+# compatible with the single-run output.
+SWEEP = [
+    ("SmolLM-360M", None, 2048, 4),   # full-depth model, no reduction
+    ("SmolLM-1.7B", 8, 4096, 2),
+    ("SmolLM-1.7B", 4, 16384, 1),     # long-context: blocked-KV flash
+    ("SmolLM-1.7B", 8, 2048, 3),      # headline
+]
+
+
+def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
+            steps: int = 8, warmup: int = 2, remat: bool = True,
+            remat_policy: str = "dots", adam_moments_dtype: str = "bfloat16",
+            profile: str | None = None) -> dict:
+    from picotron_tpu.config import (
+        Config, DistributedConfig, ModelConfig, TrainingConfig, resolve_preset,
+    )
+    from picotron_tpu.mesh import MeshEnv
+    from picotron_tpu.parallel.api import init_sharded_state, make_train_step
+    from picotron_tpu.utils import device_peak_flops, flops_per_token, mfu
+
+    n_chips = len(jax.devices())
+    preset = resolve_preset(model)
+    preset["max_position_embeddings"] = max(
+        preset.get("max_position_embeddings", seq), seq
+    )
+    if layers:
+        preset["num_hidden_layers"] = layers
+    cfg = Config(
+        distributed=DistributedConfig(dp_size=n_chips),
+        model=ModelConfig(name=model, **preset),
+        training=TrainingConfig(
+            seq_length=seq,
+            micro_batch_size=mbs,
+            gradient_accumulation_steps=grad_acc,
+            remat=remat,
+            remat_policy=remat_policy,
+            adam_moments_dtype=adam_moments_dtype,
+        ),
+    )
+    cfg.validate()
+
+    menv = MeshEnv.from_config(cfg)
+    state = init_sharded_state(cfg, menv, jax.random.key(0))
+    step = make_train_step(cfg, menv)
+
+    b_global = mbs * n_chips
+    toks = jax.random.randint(
+        jax.random.key(1), (grad_acc, b_global, seq + 1),
+        0, cfg.model.vocab_size,
+    )
+    sharding = menv.batch_sharding()
+    batch = (jax.device_put(toks[..., :-1], sharding),
+             jax.device_put(toks[..., 1:], sharding))
+
+    for _ in range(max(warmup, 1)):  # >=1 so compile stays out of the timing
+        state, loss = step(state, batch)
+    float(loss)  # value fetch: cannot return before the warmup chain ran
+
+    # Time N chained steps, fetching ONLY the final loss. The data dependency
+    # (loss_N needs state_{N-1} needs ... state_0) forces every step to have
+    # executed before the fetch returns, while avoiding a host<->device
+    # round-trip per step (which inflates step time by the transport latency;
+    # ~100ms/step over a remote-tunnel backend). block_until_ready is NOT
+    # trustworthy here — with donated (aliased) state buffers it can return
+    # before the execution chain has run; a value fetch cannot lie.
+    if profile:
+        jax.profiler.start_trace(profile)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step(state, batch)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+    if profile:
+        jax.profiler.stop_trace()
+
+    tokens_per_step = b_global * grad_acc * seq
+    tokens_per_sec = tokens_per_step * steps / dt
+    peak = device_peak_flops()
+    mfu_frac = mfu(tokens_per_sec, cfg.model, seq, n_chips, peak)
+
+    layer_tag = f"-{cfg.model.num_hidden_layers}L"
+    return {
+        "metric": f"mfu_{model.split('/')[-1]}{layer_tag}_seq{seq}",
+        "value": round(mfu_frac, 4),
+        "unit": "fraction_of_peak",
+        "vs_baseline": round(mfu_frac / 0.40, 4),
+        "tokens_per_sec_per_chip": round(tokens_per_sec / n_chips, 1),
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "n_chips": n_chips,
+        "device_kind": jax.devices()[0].device_kind,
+        "peak_flops_per_chip": peak,
+        "flops_per_token": flops_per_token(cfg.model, seq),
+        "loss": final_loss,
+        # NOTE: the bench feeds the SAME random batch every step (pure perf
+        # harness) — `loss` trends toward memorization and says nothing
+        # about model quality; see tests/test_train_e2e.py for real training.
+        "loss_is_fixed_batch_memorization": True,
+    }
+
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     # Defaults = the best-known single-chip v5e config: a depth-reduced
-    # SmolLM-1.7B (8 of 24 layers) — the full model's fp32 Adam state does
-    # not fit one 16G chip; per-layer efficiency matches the full model and
-    # the metric name records the reduction honestly.
+    # SmolLM-1.7B (8 of 24 layers) — the full model's fp32 master params +
+    # grads + moments need >17G and do not fit one 16G chip; per-layer
+    # efficiency matches the full model and the metric name records the
+    # reduction honestly. SmolLM-360M in --sweep is the full-model metric.
     ap.add_argument("--model", default="SmolLM-1.7B")
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--mbs", type=int, default=3)
@@ -50,91 +157,44 @@ def main() -> None:
                          "into DIR (open with xprof/tensorboard; see "
                          "README 'Profiling'). SURVEY.md §5 prescribes "
                          "profiler traces as the TPU observability story.")
+    ap.add_argument("--sweep", action="store_true",
+                    help="run the breadth matrix (one JSON line per config, "
+                         "headline last) instead of a single config")
     args = ap.parse_args()
 
-    from picotron_tpu.config import (
-        Config, DistributedConfig, ModelConfig, TrainingConfig, resolve_preset,
-    )
-    from picotron_tpu.mesh import MeshEnv
-    from picotron_tpu.parallel.api import init_sharded_state, make_train_step
-    from picotron_tpu.utils import device_peak_flops, flops_per_token, mfu
+    if args.sweep:
+        from picotron_tpu.config import resolve_preset
 
-    n_chips = len(jax.devices())
-    preset = resolve_preset(args.model)
-    preset["max_position_embeddings"] = max(
-        preset.get("max_position_embeddings", args.seq), args.seq
-    )
+        # the matrix pins per-config shape flags; only these compose with it
+        defaults = {"model": "SmolLM-1.7B", "seq": 2048, "mbs": 3,
+                    "grad_acc": 1, "layers": None, "profile": None,
+                    "no_remat": False}
+        clashing = [k for k, v in defaults.items()
+                    if getattr(args, k.replace("-", "_")) != v]
+        if clashing:
+            ap.error(f"--sweep runs a fixed config matrix; incompatible "
+                     f"with: {', '.join('--' + c for c in clashing)}")
+        for model, layers, seq, mbs in SWEEP:
+            depth = layers or resolve_preset(model)["num_hidden_layers"]
+            try:
+                print(json.dumps(run_one(
+                    model, layers, seq, mbs, steps=args.steps,
+                    warmup=args.warmup, remat_policy=args.remat_policy,
+                    adam_moments_dtype=args.adam_moments_dtype)), flush=True)
+            except Exception as e:  # one OOM must not kill the matrix
+                print(json.dumps({
+                    "metric": f"mfu_{model.split('/')[-1]}-{depth}L_seq{seq}",
+                    "error": str(e)[:200],
+                }), flush=True)
+        return
+
     if args.layers is None and args.model == "SmolLM-1.7B":
-        args.layers = 8  # the full model's fp32 Adam state exceeds one chip
-    if args.layers:
-        preset["num_hidden_layers"] = args.layers
-    cfg = Config(
-        distributed=DistributedConfig(dp_size=n_chips),
-        model=ModelConfig(name=args.model, **preset),
-        training=TrainingConfig(
-            seq_length=args.seq,
-            micro_batch_size=args.mbs,
-            gradient_accumulation_steps=args.grad_acc,
-            remat=not args.no_remat,
-            remat_policy=args.remat_policy,
-            adam_moments_dtype=args.adam_moments_dtype,
-        ),
-    )
-    cfg.validate()
-
-    menv = MeshEnv.from_config(cfg)
-    state = init_sharded_state(cfg, menv, jax.random.key(0))
-    step = make_train_step(cfg, menv)
-
-    b_global = args.mbs * n_chips
-    toks = jax.random.randint(
-        jax.random.key(1), (args.grad_acc, b_global, args.seq + 1),
-        0, cfg.model.vocab_size,
-    )
-    sharding = menv.batch_sharding()
-    batch = (jax.device_put(toks[..., :-1], sharding),
-             jax.device_put(toks[..., 1:], sharding))
-
-    for _ in range(max(args.warmup, 1)):  # >=1 so compile stays out of the timing
-        state, loss = step(state, batch)
-    float(loss)  # value fetch: cannot return before the warmup chain ran
-
-    # Time N chained steps, fetching ONLY the final loss. The data dependency
-    # (loss_N needs state_{N-1} needs ... state_0) forces every step to have
-    # executed before the fetch returns, while avoiding a host<->device
-    # round-trip per step (which inflates step time by the transport latency;
-    # ~100ms/step over a remote-tunnel backend). block_until_ready is NOT
-    # trustworthy here — with donated (aliased) state buffers it can return
-    # before the execution chain has run; a value fetch cannot lie.
-    if args.profile:
-        jax.profiler.start_trace(args.profile)
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        state, loss = step(state, batch)
-    final_loss = float(loss)
-    dt = time.perf_counter() - t0
-    if args.profile:
-        jax.profiler.stop_trace()
-
-    tokens_per_step = b_global * args.grad_acc * args.seq
-    tokens_per_sec = tokens_per_step * args.steps / dt
-    peak = device_peak_flops()
-    mfu_frac = mfu(tokens_per_sec, cfg.model, args.seq, n_chips, peak)
-
-    layer_tag = f"-{cfg.model.num_hidden_layers}L"
-    print(json.dumps({
-        "metric": f"mfu_{args.model.split('/')[-1]}{layer_tag}_seq{args.seq}",
-        "value": round(mfu_frac, 4),
-        "unit": "fraction_of_peak",
-        "vs_baseline": round(mfu_frac / 0.40, 4),
-        "tokens_per_sec_per_chip": round(tokens_per_sec / n_chips, 1),
-        "tokens_per_sec": round(tokens_per_sec, 1),
-        "n_chips": n_chips,
-        "device_kind": jax.devices()[0].device_kind,
-        "peak_flops_per_chip": peak,
-        "flops_per_token": flops_per_token(cfg.model, args.seq),
-        "loss": final_loss,
-    }))
+        args.layers = 8  # the full model's optimizer state exceeds one chip
+    print(json.dumps(run_one(
+        args.model, args.layers, args.seq, args.mbs, grad_acc=args.grad_acc,
+        steps=args.steps, warmup=args.warmup, remat=not args.no_remat,
+        remat_policy=args.remat_policy,
+        adam_moments_dtype=args.adam_moments_dtype, profile=args.profile)))
 
 
 if __name__ == "__main__":

@@ -103,6 +103,12 @@ def resolve_preset(name: str) -> dict[str, Any]:
     )
 
 
+def resolve_hf_name(name: str) -> str:
+    """Canonical HF hub id for a preset shorthand ('SmolLM-1.7B' ->
+    'HuggingFaceTB/SmolLM-1.7B'); unknown names pass through unchanged."""
+    return _PRESET_ALIASES.get(name, name)
+
+
 # ---------------------------------------------------------------------------
 # Config sections — mirror the reference JSON sections one-to-one.
 # ---------------------------------------------------------------------------

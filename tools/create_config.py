@@ -59,6 +59,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tokenizer", default=None)
     # checkpoint / logging
     p.add_argument("--save-frequency", type=int, default=0)
+    p.add_argument("--download-model", action="store_true",
+                   help="snapshot the model's HF safetensors (tools/"
+                        "download_model.py; ref: create_config.py:134) and "
+                        "set checkpoint.init_from_hf so training starts "
+                        "from the pretrained weights")
     p.add_argument("--use-wandb", action="store_true")
     p.add_argument("--use-cpu", action="store_true",
                    help="run the layout on simulated host devices (the "
@@ -104,6 +109,14 @@ def create_single_config(args) -> str:
         "checkpoint": {"save_frequency": args.save_frequency},
         "logging": {"use_wandb": args.use_wandb, "run_name": args.exp_name},
     }
+    if getattr(args, "download_model", False):
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from download_model import download
+
+        from picotron_tpu.config import resolve_hf_name
+
+        raw["checkpoint"]["init_from_hf"] = download(
+            resolve_hf_name(args.model))
     cfg = config_from_dict(raw)  # validates
 
     exp_dir = os.path.join(args.out_dir, args.exp_name)

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Download a HuggingFace model's safetensors for `checkpoint.init_from_hf`.
+
+TPU-native counterpart of the reference's `download_model` (ref:
+picotron/utils.py:100-115, called from create_config.py:134): snapshots only
+the weight/config/tokenizer files, then prints the directory to put in the
+config's `checkpoint.init_from_hf` field. Unlike the reference, the weights
+are actually LOADED as initial values by `load_hf_safetensors`
+(picotron_tpu/checkpoint.py), not just used as shape templates.
+
+Zero-egress pods (no outbound network) get a clear actionable error instead
+of a hang: pre-download on a connected machine and ship the directory, or
+point `init_from_hf` at any local safetensors checkout.
+
+Usage:
+    python tools/download_model.py HuggingFaceTB/SmolLM-1.7B [--out DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def download(model_name: str, out_dir: str | None = None) -> str:
+    """Snapshot `model_name`'s safetensors + config + tokenizer into
+    `out_dir` (default ./hf_models/<name>); returns the local directory."""
+    # Absolute so the path written into checkpoint.init_from_hf keeps
+    # working when training launches from a different cwd.
+    out_dir = os.path.abspath(
+        out_dir or os.path.join("hf_models", model_name.split("/")[-1]))
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError as e:
+        raise SystemExit(
+            "huggingface_hub is not installed; install it or place the "
+            "model's *.safetensors + config.json under a directory and set "
+            "checkpoint.init_from_hf to that path."
+        ) from e
+    try:
+        snapshot_download(
+            model_name,
+            local_dir=out_dir,
+            allow_patterns=["*.safetensors", "*.safetensors.index.json",
+                            "config.json", "tokenizer*", "*.model"],
+        )
+    except Exception as e:
+        raise SystemExit(
+            f"download of {model_name!r} failed ({type(e).__name__}: {e}).\n"
+            f"On an air-gapped/zero-egress pod: run this tool on a connected "
+            f"machine, copy {out_dir!r} over, and set "
+            f"checkpoint.init_from_hf to it."
+        ) from e
+    return out_dir
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("model", help="HF hub id, e.g. HuggingFaceTB/SmolLM-1.7B")
+    ap.add_argument("--out", default=None,
+                    help="target directory (default hf_models/<name>)")
+    args = ap.parse_args(argv)
+    path = download(args.model, args.out)
+    print(path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
