@@ -129,6 +129,13 @@ class DistributedConfig:
     # a zigzag TODO, ref: data.py:105-109, tests/test_dataloader.py:136).
     # "contiguous" reproduces the reference layout.
     cp_layout: str = "zigzag"
+    # Megatron-style sequence parallelism over the tp axis (the reference
+    # leaves this as a TODO, ref: utils.py:66): between blocks the residual
+    # stream / norms are sharded [*, S/tp, H] and the TP entry/exit
+    # collectives become all_gather / reduce_scatter (same bytes as the
+    # psum they replace, tp x less activation memory at layer boundaries,
+    # tp x less pipeline boundary traffic).
+    sequence_parallel: bool = False
     # Accepted for reference-JSON compatibility; ignored (XLA picks transport).
     backend: str = "jax"
     use_cpu: bool = False
@@ -319,6 +326,12 @@ class Config:
             raise ValueError(f"seq_length must be >= 1, got {t.seq_length}")
         if t.seq_length % d.cp_size != 0:
             raise ValueError("seq_length must be divisible by cp_size")
+        if (d.sequence_parallel
+                and t.seq_length % (d.cp_size * d.tp_size) != 0):
+            raise ValueError(
+                "sequence_parallel shards the cp-local sequence over tp: "
+                "seq_length must be divisible by cp_size * tp_size "
+                f"(= {d.cp_size * d.tp_size}), got {t.seq_length}")
         if (d.cp_size > 1 and d.cp_layout == "zigzag"
                 and t.seq_length % (2 * d.cp_size) != 0):
             raise ValueError(

@@ -88,6 +88,10 @@ class ParallelCtx:
     # global positions of this shard's tokens [S_local] (context parallelism;
     # None = 0..S-1)
     positions: Optional[jnp.ndarray] = None
+    # factor by which the residual stream's sequence dim is sharded relative
+    # to the input ids (sequence parallelism: tp_size; otherwise 1). Pipeline
+    # boundary buffers are sized S_local / seq_shard.
+    seq_shard: int = 1
     # gradient checkpointing over decoder layers
     remat: bool = False
     # "full" | "dots" (save matmul outputs, recompute elementwise only)
@@ -224,11 +228,12 @@ def embed(params: Params, input_ids: jnp.ndarray, cfg: ModelConfig,
 def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
     """RMSNorm -> qkv -> RoPE -> attention -> out_proj (ref: model.py:122-162)."""
     dt = x.dtype
-    b, s, _ = x.shape
     d = cfg.head_dim
 
     h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-    h = ctx.f(h)  # column-parallel entry: identity fwd / psum-over-tp bwd
+    h = ctx.f(h)  # column-parallel entry: identity fwd / psum bwd; under
+    # sequence parallelism an all_gather that restores the full sequence
+    b, s, _ = h.shape
     q = h @ lp["q"].astype(dt)
     k = h @ lp["k"].astype(dt)
     v = h @ lp["v"].astype(dt)
@@ -314,6 +319,10 @@ def final_hidden(params: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarra
 
 def logits_from_hidden(params: Params, x: jnp.ndarray, cfg: ModelConfig,
                        ctx: ParallelCtx = DEFAULT_CTX) -> jnp.ndarray:
+    # Under sequence parallelism x arrives seq-sharded; the column-parallel
+    # entry hook re-gathers the sequence before the vocab-sharded head
+    # (identity on every other path).
+    x = ctx.f(x)
     logits = x @ params["lm_head"].astype(x.dtype)
     return ctx.gather_logits(logits)
 

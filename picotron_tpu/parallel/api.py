@@ -37,6 +37,8 @@ from picotron_tpu.optimizer import make_optimizer
 from picotron_tpu.parallel.sharding import batch_spec, param_shardings, param_specs
 from picotron_tpu.parallel.tp import (
     gather_logits,
+    sp_gather_seq,
+    sp_scatter_seq,
     vocab_parallel_ce_sum_count,
     vocab_parallel_embed,
 )
@@ -112,15 +114,34 @@ def make_parallel_ctx(cfg: Config) -> ParallelCtx:
             return attn_fn(q, k, v, causal=True,
                            q_positions=pos, kv_positions=pos)
 
-    return ParallelCtx(
-        attn=attn,
+    ce = partial(vocab_parallel_ce_sum_count, axis="tp")
+    hooks = dict(
         g=lambda x: lax.psum(x, "tp"),
         embed_lookup=partial(vocab_parallel_embed, axis="tp"),
-        head_ce=partial(vocab_parallel_ce_sum_count, axis="tp"),
+        head_ce=ce,
+    )
+    if d.sequence_parallel:
+        # Megatron-SP (parallel/tp.py): residual stream seq-sharded over tp,
+        # f/g become all_gather / reduce-scatter. head_ce and the eval logits
+        # path re-gather the sequence before the head matmul (a seq-sharded
+        # hidden against a vocab-sharded head would yield diagonal blocks of
+        # the logits, which cannot be assembled).
+        hooks = dict(
+            f=sp_gather_seq,
+            g=sp_scatter_seq,
+            embed_lookup=partial(vocab_parallel_embed, axis="tp",
+                                 scatter_seq=True),
+            head_ce=lambda x, head, tgt: ce(sp_gather_seq(x), head, tgt),
+            seq_shard=d.tp_size,
+        )
+
+    return ParallelCtx(
+        attn=attn,
         gather_logits=partial(gather_logits, axis="tp"),
         positions=positions,
         remat=cfg.training.remat,
         remat_policy=cfg.training.remat_policy,
+        **hooks,
     )
 
 
@@ -139,7 +160,7 @@ def _device_grads(params, batch, cfg: Config):
         # dispatches to the pipeline engines the same way).
         from picotron_tpu.parallel.pp import (
             pipeline_1f1b_grads, pipeline_loss_sum_count,
-            sync_pp_replicated_grads,
+            sync_pp_replicated_grads, sync_sp_partial_grads,
         )
 
         if cfg.distributed.pp_engine == "1f1b":
@@ -155,6 +176,8 @@ def _device_grads(params, batch, cfg: Config):
             (nll_total, count), grads = jax.value_and_grad(
                 pp_nll, has_aux=True)(params)
         grads = sync_pp_replicated_grads(grads, param_specs(cfg))
+        if cfg.distributed.sequence_parallel:
+            grads = sync_sp_partial_grads(grads, params)
         grads = lax.psum(grads, ("dp", "cp"))
         nll_total = lax.psum(nll_total, ("dp", "cp"))
         count = jnp.maximum(lax.psum(count, ("dp", "cp")), 1)

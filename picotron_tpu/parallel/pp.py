@@ -73,6 +73,13 @@ def _cast_varying_like(x, target):
     return _vary_over(x, set(jax.typeof(target).vma))
 
 
+def _boundary_axes(ctx) -> tuple:
+    """Mesh axes the pipeline's activation boundary buffers vary over. A
+    seq-sharded residual stream (sequence parallelism) is tp-VARYING; the
+    nll/count scalars never are (head_ce psums over tp)."""
+    return ("dp", "cp", "pp") + (("tp",) if ctx.seq_shard > 1 else ())
+
+
 def _make_stage_fn(ids, tgt, m, ctx: ParallelCtx, cos, sin, s_idx, pp):
     """One stage-forward unit, shared by both engines.
 
@@ -146,9 +153,13 @@ def pipeline_loss_sum_count(params, ids, tgt, cfg: Config, ctx: ParallelCtx):
     if ctx.remat:
         body = jax.checkpoint(body, policy=remat_policy_for(ctx.remat_policy))
 
-    x0_buf = jnp.zeros((mbs, s_local, m.hidden_size), dtype)
-    init = lax.pcast(
-        (x0_buf, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+    # Boundary buffers carry the residual stream, which sequence parallelism
+    # shards to s_local / seq_shard (tp x less ppermute traffic per tick).
+    x0_buf = lax.pcast(
+        jnp.zeros((mbs, s_local // ctx.seq_shard, m.hidden_size), dtype),
+        _boundary_axes(ctx), to="varying")
+    init = (x0_buf,) + lax.pcast(
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
         ("dp", "cp", "pp"), to="varying")
     (x_last, nll_sum, cnt), _ = lax.scan(body, init, jnp.arange(n_ticks))
 
@@ -237,17 +248,23 @@ def pipeline_1f1b_grads(params, ids, tgt, cfg: Config, ctx: ParallelCtx):
 
         return (ring, y_send, g_send, g_acc, nll_acc, cnt_acc), None
 
-    x0 = jnp.zeros((mbs, s_local, m.hidden_size), dtype)
+    x0 = jnp.zeros((mbs, s_local // ctx.seq_shard, m.hidden_size), dtype)
     bufs = lax.pcast(
-        (jnp.zeros((pp,) + x0.shape, dtype), x0, x0,
-         jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        (jnp.zeros((pp,) + x0.shape, dtype), x0, x0),
+        _boundary_axes(ctx), to="varying"
+    ) + lax.pcast(
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
         ("dp", "cp", "pp"), to="varying")
     # Each grad-accumulator leaf varies over the data axes plus whatever its
     # param already varies over (tp/pp shardings) — matching what the VJP
-    # emits each tick, so the scan carry type is stable.
+    # emits each tick, so the scan carry type is stable. Under sequence
+    # parallelism the per-tick VJP grads of tp-replicated params (norms)
+    # are per-rank partials over this rank's seq shard, hence tp-varying;
+    # sync_sp_partial_grads completes them with a tp psum after the scan.
     g_zero = jax.tree.map(
         lambda p: _vary_over(jnp.zeros_like(p),
-                             {"dp", "cp", "pp"} | set(jax.typeof(p).vma)),
+                             set(_boundary_axes(ctx))
+                             | set(jax.typeof(p).vma)),
         params)
     init = (bufs[0], bufs[1], bufs[2], g_zero, bufs[3], bufs[4])
     (_, _, _, grads, nll_sum, cnt), _ = lax.scan(tick, init, jnp.arange(n_ticks))
@@ -278,3 +295,20 @@ def sync_pp_replicated_grads(grads, specs):
 
     return jax.tree.map(fix, grads, specs,
                         is_leaf=lambda x: isinstance(x, P))
+
+
+def sync_sp_partial_grads(grads, params):
+    """Under sequence parallelism, complete the grads of tp-replicated
+    params (the norm weights): each tp rank accumulated the partial over its
+    sequence shard (tp-varying leaf), and the psum assembles the full sum.
+    tp-sharded params (vma already contains 'tp') are genuine shards, not
+    partials — left untouched. No-op tree-wide when nothing is tp-varying
+    beyond its param (the automatic pvary-transpose psum already ran, e.g.
+    the AFAB jax.grad path)."""
+
+    def fix(g, p):
+        if "tp" in jax.typeof(g).vma and "tp" not in jax.typeof(p).vma:
+            return lax.psum(g, "tp")
+        return g
+
+    return jax.tree.map(fix, grads, params)

@@ -34,11 +34,15 @@ from picotron_tpu.ops.losses import IGNORE_INDEX
 
 
 def vocab_parallel_embed(w_shard: jnp.ndarray, ids: jnp.ndarray,
-                         axis: str = "tp") -> jnp.ndarray:
+                         axis: str = "tp",
+                         scatter_seq: bool = False) -> jnp.ndarray:
     """Embedding lookup with the vocab dimension sharded over `axis`.
 
     w_shard: [vocab/tp, hidden] local shard; ids replicated.
     Out-of-shard ids contribute zero; psum over tp assembles the full row.
+    With `scatter_seq` (sequence parallelism) the psum becomes a
+    psum_scatter over the sequence dim, handing each tp rank its
+    [*, S/tp, H] slice of the residual stream.
     """
     vshard = w_shard.shape[0]
     lo = lax.axis_index(axis) * vshard
@@ -46,7 +50,29 @@ def vocab_parallel_embed(w_shard: jnp.ndarray, ids: jnp.ndarray,
     ok = (rel >= 0) & (rel < vshard)
     rel = jnp.clip(rel, 0, vshard - 1)
     x = w_shard[rel] * ok[..., None].astype(w_shard.dtype)
+    if scatter_seq:
+        return lax.psum_scatter(x, axis, scatter_dimension=1, tiled=True)
     return lax.psum(x, axis)
+
+
+# -- sequence parallelism (SP) hooks ----------------------------------------
+# Megatron-SP's g/ḡ pair (Korthikanti et al. 2022): with the residual
+# stream seq-sharded over tp, the column-parallel entry gathers the
+# sequence (backward: reduce-scatter of the grad — JAX's transpose of a
+# tiled all_gather) and the row-parallel exit reduce-scatters the partial
+# sums (backward: all_gather). Same total bytes as the psum pair they
+# replace; tp x less activation memory between blocks.
+
+
+def sp_gather_seq(x: jnp.ndarray, axis: str = "tp") -> jnp.ndarray:
+    """[*, S/tp, H] -> [*, S, H]; the SP column-parallel entry (`f`)."""
+    return lax.all_gather(x, axis, axis=1, tiled=True)
+
+
+def sp_scatter_seq(x: jnp.ndarray, axis: str = "tp") -> jnp.ndarray:
+    """partial [*, S, H] -> reduced [*, S/tp, H]; the SP row-parallel
+    exit (`g`)."""
+    return lax.psum_scatter(x, axis, scatter_dimension=1, tiled=True)
 
 
 def vocab_parallel_ce_sum_count(hidden: jnp.ndarray, head_shard: jnp.ndarray,

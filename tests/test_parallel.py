@@ -104,6 +104,13 @@ def run_single(cfg_parallel, steps=3):
     dict(pp_size=4, layers=5, gas=4, tp_size=2),
     dict(dp_size=2, pp_size=2, cp_size=2),
     dict(dp_size=2, pp_size=2, tp_size=2),
+    # Megatron-style sequence parallelism over tp (seq-sharded residual
+    # stream, all_gather/reduce-scatter f/g) must be numerically invisible
+    dict(tp_size=4, sequence_parallel=True),
+    dict(dp_size=2, tp_size=2, sequence_parallel=True),
+    dict(dp_size=2, tp_size=2, sequence_parallel=True, cp_size=2),
+    dict(pp_size=2, tp_size=2, sequence_parallel=True),
+    dict(pp_size=2, tp_size=2, sequence_parallel=True, pp_engine="afab"),
 ])
 def test_layouts_match_single_device(dist):
     cfg = tiny_cfg(**dist)

@@ -35,6 +35,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pp", type=int, default=1)
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--pp-engine", default="1f1b", choices=["1f1b", "afab"])
+    p.add_argument("--sequence-parallel", action="store_true",
+                   help="Megatron-SP over the tp axis (seq-sharded "
+                        "residual stream between blocks)")
     # model
     p.add_argument("--model", default="HuggingFaceTB/SmolLM-1.7B")
     p.add_argument("--num-hidden-layers", type=int, default=None,
@@ -88,6 +91,7 @@ def create_single_config(args) -> str:
         "distributed": {
             "tp_size": args.tp, "cp_size": args.cp, "pp_size": args.pp,
             "dp_size": args.dp, "pp_engine": args.pp_engine,
+            "sequence_parallel": args.sequence_parallel,
             "use_cpu": args.use_cpu,
         },
         "model": {
