@@ -175,17 +175,25 @@ class CheckpointManager:
 # safetensors name -> (our key path, needs_transpose). Torch Linear stores
 # [out_features, in_features]; our matmuls are x @ w with [in, out]
 # (the reference's regex rename map is checkpoint.py:213-230).
-_LAYER_MAP = {
+_ATTN_MAP = {
     "self_attn.q_proj.weight": ("q", True),
     "self_attn.k_proj.weight": ("k", True),
     "self_attn.v_proj.weight": ("v", True),
     "self_attn.o_proj.weight": ("o", True),
-    "mlp.gate_proj.weight": ("gate", True),
-    "mlp.up_proj.weight": ("up", True),
-    "mlp.down_proj.weight": ("down", True),
     "input_layernorm.weight": ("input_norm", False),
     "post_attention_layernorm.weight": ("post_norm", False),
 }
+
+_LAYER_MAP = {
+    **_ATTN_MAP,
+    "mlp.gate_proj.weight": ("gate", True),
+    "mlp.up_proj.weight": ("up", True),
+    "mlp.down_proj.weight": ("down", True),
+}
+
+# Mixtral MoE expert naming: block_sparse_moe.experts.<j>.{w1,w2,w3} hold
+# gate/down/up projections, block_sparse_moe.gate is the router.
+_MOE_EXPERT_MAP = {"w1": "w_gate", "w2": "w_down", "w3": "w_up"}
 
 
 def _read_safetensors_dir(path: str) -> dict[str, np.ndarray]:
@@ -223,12 +231,22 @@ def load_hf_safetensors(path: str, cfg: ModelConfig,
                 f"{len(raw)} tensors)")
         return raw[name].astype(np.float32)
 
-    layers: dict[str, list[np.ndarray]] = {k: [] for k, _ in _LAYER_MAP.values()}
+    lmap = _ATTN_MAP if cfg.num_experts else _LAYER_MAP
+    layers: dict[str, list[np.ndarray]] = {k: [] for k, _ in lmap.values()}
+    if cfg.num_experts:
+        layers.update({k: [] for k in ("router", "w_gate", "w_up", "w_down")})
     for i in range(nl):
         prefix = f"model.layers.{i}."
-        for suffix, (key, transpose) in _LAYER_MAP.items():
+        for suffix, (key, transpose) in lmap.items():
             t = get(prefix + suffix)
             layers[key].append(t.T if transpose else t)
+        if cfg.num_experts:
+            moe = prefix + "block_sparse_moe."
+            layers["router"].append(get(moe + "gate.weight").T)  # [H, E]
+            for short, key in _MOE_EXPERT_MAP.items():
+                bank = [get(f"{moe}experts.{j}.{short}.weight").T
+                        for j in range(cfg.num_experts)]
+                layers[key].append(np.stack(bank))  # [E, in, out]
 
     embedding = get("model.embed_tokens.weight")  # [vocab, hidden]
     if "lm_head.weight" in raw:
@@ -260,10 +278,19 @@ def save_hf_safetensors(params: dict[str, Any], path: str) -> None:
     out["lm_head.weight"] = np.asarray(params["lm_head"]).T
     layers = params["layers"]
     nl = next(iter(layers.values())).shape[0]
+    is_moe = "router" in layers
+    lmap = _ATTN_MAP if is_moe else _LAYER_MAP
     for i in range(nl):
         prefix = f"model.layers.{i}."
-        for suffix, (key, transpose) in _LAYER_MAP.items():
+        for suffix, (key, transpose) in lmap.items():
             t = np.asarray(layers[key][i])
             out[prefix + suffix] = t.T if transpose else t
+        if is_moe:
+            moe = prefix + "block_sparse_moe."
+            out[moe + "gate.weight"] = np.asarray(layers["router"][i]).T
+            for short, key in _MOE_EXPERT_MAP.items():
+                bank = np.asarray(layers[key][i])  # [E, in, out]
+                for j in range(bank.shape[0]):
+                    out[f"{moe}experts.{j}.{short}.weight"] = bank[j].T
     out = {k: np.ascontiguousarray(v) for k, v in out.items()}
     save_file(out, os.path.join(path, "model.safetensors"))

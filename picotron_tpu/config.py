@@ -69,11 +69,25 @@ MODEL_PRESETS: dict[str, dict[str, Any]] = {
         num_hidden_layers=22, num_attention_heads=32, num_key_value_heads=4,
         max_position_embeddings=2048, rope_theta=10000.0, rms_norm_eps=1e-5,
     ),
+    # Mixtral (MoE family; beyond the reference's dense-only coverage)
+    "mistralai/Mixtral-8x7B-v0.1": dict(
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+        max_position_embeddings=32768, rope_theta=1e6, rms_norm_eps=1e-5,
+        num_experts=8, num_experts_per_token=2,
+    ),
     # Tiny debug model for tests / CI
     "picotron-tpu/debug-tiny": dict(
         vocab_size=256, hidden_size=64, intermediate_size=128,
         num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
         max_position_embeddings=2048, rope_theta=10000.0, rms_norm_eps=1e-5,
+    ),
+    # Tiny MoE debug model (8 experts, top-2)
+    "picotron-tpu/debug-tiny-moe": dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=2048, rope_theta=10000.0, rms_norm_eps=1e-5,
+        num_experts=8, num_experts_per_token=2,
     ),
 }
 
@@ -88,7 +102,9 @@ _PRESET_ALIASES = {
     "Llama-2-13B": "meta-llama/Llama-2-13b-hf",
     "Llama-3-8B": "meta-llama/Meta-Llama-3-8B",
     "TinyLlama-1.1B": "TinyLlama/TinyLlama-1.1B-Chat-v1.0",
+    "Mixtral-8x7B": "mistralai/Mixtral-8x7B-v0.1",
     "debug-tiny": "picotron-tpu/debug-tiny",
+    "debug-tiny-moe": "picotron-tpu/debug-tiny-moe",
 }
 
 
@@ -129,6 +145,11 @@ class DistributedConfig:
     # a zigzag TODO, ref: data.py:105-109, tests/test_dataloader.py:136).
     # "contiguous" reproduces the reference layout.
     cp_layout: str = "zigzag"
+    # Expert parallelism: shards MoE expert banks over a dedicated mesh
+    # axis; acts as an additional data axis for non-expert computation
+    # (batch over the fused ('dp','ep') axes). Requires a MoE model
+    # (model.num_experts > 0) when > 1.
+    ep_size: int = 1
     # Megatron-style sequence parallelism over the tp axis (the reference
     # leaves this as a TODO, ref: utils.py:66): between blocks the residual
     # stream / norms are sharded [*, S/tp, H] and the TP entry/exit
@@ -142,10 +163,11 @@ class DistributedConfig:
 
     @property
     def world_size(self) -> int:
-        return self.tp_size * self.cp_size * self.pp_size * self.dp_size
+        return (self.tp_size * self.cp_size * self.pp_size * self.dp_size
+                * self.ep_size)
 
     def validate(self) -> None:
-        for name in ("tp_size", "cp_size", "pp_size", "dp_size"):
+        for name in ("tp_size", "cp_size", "pp_size", "dp_size", "ep_size"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1, got {getattr(self, name)}")
         if self.pp_engine not in ("1f1b", "afab"):
@@ -177,6 +199,17 @@ class ModelConfig:
     # Attention implementation: "auto" picks flash on TPU / reference on CPU;
     # CP > 1 always routes through the ring (ref: model.py:148-158 dispatch).
     attn_impl: str = "auto"  # "auto" | "flash" | "reference" | "ring"
+    # Mixture-of-experts (beyond the reference, SURVEY §2.2 marks EP absent):
+    # num_experts = 0 keeps the dense SwiGLU MLP; > 0 replaces every MLP with
+    # a top-k-routed expert bank (Mixtral-style: softmax over the top-k
+    # router logits) plus a load-balancing aux loss. Experts shard over the
+    # 'ep' mesh axis; dispatch is capacity-bounded (GShard-style) so shapes
+    # stay static for XLA.
+    num_experts: int = 0
+    num_experts_per_token: int = 2
+    moe_intermediate_size: Optional[int] = None  # default: intermediate_size
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
     # Accepted for reference compat (ref uses them to pick CUDA kernels).
     use_flash_attention: bool = True
     use_fused_adam: bool = True
@@ -184,6 +217,10 @@ class ModelConfig:
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_attention_heads
+
+    @property
+    def expert_ffn_size(self) -> int:
+        return self.moe_intermediate_size or self.intermediate_size
 
     def validate(self) -> None:
         if self.attn_impl not in ("auto", "flash", "reference", "ring"):
@@ -285,7 +322,8 @@ class Config:
     @property
     def global_batch_size(self) -> int:
         t = self.training
-        return t.micro_batch_size * t.gradient_accumulation_steps * self.distributed.dp_size
+        return (t.micro_batch_size * t.gradient_accumulation_steps
+                * self.distributed.dp_size * self.distributed.ep_size)
 
     @property
     def tokens_per_step(self) -> int:
@@ -305,6 +343,22 @@ class Config:
             raise ValueError("num_key_value_heads must be divisible by tp_size")
         if m.vocab_size % d.tp_size != 0:
             raise ValueError("vocab_size must be divisible by tp_size")
+        if d.ep_size > 1 and m.num_experts == 0:
+            raise ValueError(
+                "ep_size > 1 requires a mixture-of-experts model "
+                "(model.num_experts > 0)")
+        if m.num_experts:
+            if m.num_experts % d.ep_size != 0:
+                raise ValueError(
+                    f"num_experts ({m.num_experts}) must be divisible by "
+                    f"ep_size ({d.ep_size})")
+            if not 1 <= m.num_experts_per_token <= m.num_experts:
+                raise ValueError(
+                    f"num_experts_per_token must be in [1, num_experts], "
+                    f"got {m.num_experts_per_token} of {m.num_experts}")
+            if m.expert_ffn_size % d.tp_size != 0:
+                raise ValueError(
+                    "expert ffn size must be divisible by tp_size")
         if t.remat_policy not in ("full", "dots"):
             raise ValueError(
                 f"remat_policy must be 'full' or 'dots', got {t.remat_policy!r}")
@@ -435,16 +489,25 @@ def save_config(cfg: Config, path: str) -> None:
         json.dump(cfg.to_json_dict(), f, indent=2)
 
 
-def num_params(m: ModelConfig) -> int:
+def num_params(m: ModelConfig, active_only: bool = False) -> int:
     """Total parameter count (embedding + untied head counted separately,
-    matching the reference's accounting in utils.py:50-79)."""
+    matching the reference's accounting in utils.py:50-79). For MoE,
+    `active_only` counts the top-k experts a token actually visits — the N
+    that belongs in the 6N FLOPs/token formula."""
     h, i, v, l = m.hidden_size, m.intermediate_size, m.vocab_size, m.num_hidden_layers
     kv = m.num_key_value_heads * m.head_dim
+    if m.num_experts:
+        e_ffn = 3 * h * m.expert_ffn_size  # gate/up/down per expert
+        n_ffn_experts = (m.num_experts_per_token if active_only
+                         else m.num_experts)
+        ffn = h * m.num_experts + n_ffn_experts * e_ffn  # router + experts
+    else:
+        ffn = 3 * h * i  # gate/up/down
     per_layer = (
         h * h  # q_proj
         + h * kv * 2  # k/v_proj
         + h * h  # out_proj
-        + 3 * h * i  # gate/up/down
+        + ffn
         + 2 * h  # two RMSNorm weights
     )
     return v * h + l * per_layer + h + h * v  # embed + layers + final_norm + head

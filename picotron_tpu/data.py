@@ -256,7 +256,8 @@ class MicroBatchDataLoader:
         t = self.cfg.training
         blocks = rows.reshape(
             t.gradient_accumulation_steps,
-            t.micro_batch_size * self.cfg.distributed.dp_size,
+            t.micro_batch_size * self.cfg.distributed.dp_size
+            * self.cfg.distributed.ep_size,
             self.seq_length + 1,
         )
         ids = blocks[..., :-1]

@@ -29,8 +29,11 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# Canonical axis names, outermost to innermost.
-AXES = ("dp", "pp", "cp", "tp")
+# Canonical axis names, outermost to innermost. 'ep' (expert parallelism,
+# beyond the reference's 4D: SURVEY §2.2 marks EP absent) acts as an extra
+# data axis for everything except expert weights, which shard their expert
+# dim over it; MoE dispatch rides `lax.all_to_all(..., 'ep')`.
+AXES = ("dp", "pp", "ep", "cp", "tp")
 
 
 def force_host_device_count(n: int) -> None:
@@ -72,22 +75,25 @@ class MeshEnv:
         pp: int = 1,
         cp: int = 1,
         tp: int = 1,
+        ep: int = 1,
         devices: Optional[Sequence[jax.Device]] = None,
     ) -> "MeshEnv":
         devices = list(devices if devices is not None else jax.devices())
-        world = dp * pp * cp * tp
+        world = dp * pp * ep * cp * tp
         if world > len(devices):
             raise ValueError(
-                f"dp*pp*cp*tp = {world} exceeds available devices ({len(devices)}). "
-                "(ref parity: train.py:86 asserts world_size == dp*pp*cp*tp)"
+                f"dp*pp*ep*cp*tp = {world} exceeds available devices "
+                f"({len(devices)}). (ref parity: train.py:86 asserts "
+                "world_size == dp*pp*cp*tp)"
             )
-        grid = np.array(devices[:world]).reshape(dp, pp, cp, tp)
+        grid = np.array(devices[:world]).reshape(dp, pp, ep, cp, tp)
         return MeshEnv(Mesh(grid, AXES))
 
     @staticmethod
     def from_config(cfg) -> "MeshEnv":
         d = cfg.distributed
-        return MeshEnv.create(dp=d.dp_size, pp=d.pp_size, cp=d.cp_size, tp=d.tp_size)
+        return MeshEnv.create(dp=d.dp_size, pp=d.pp_size, cp=d.cp_size,
+                              tp=d.tp_size, ep=getattr(d, "ep_size", 1))
 
     # -- axis sizes --------------------------------------------------------
 
@@ -108,8 +114,12 @@ class MeshEnv:
         return self.mesh.shape["tp"]
 
     @property
+    def ep(self) -> int:
+        return self.mesh.shape["ep"]
+
+    @property
     def world_size(self) -> int:
-        return self.dp * self.pp * self.cp * self.tp
+        return self.dp * self.pp * self.ep * self.cp * self.tp
 
     # -- sharding vocabulary ----------------------------------------------
 
@@ -121,11 +131,12 @@ class MeshEnv:
         return NamedSharding(self.mesh, P())
 
     def batch_sharding(self) -> NamedSharding:
-        """Sharding for a [micro, batch, seq] token block: batch over dp,
-        sequence over cp. The contiguous per-cp-rank sequence slice the
-        reference does by hand in its collate fn (ref: data.py:105-109) falls
-        out of sharding the sequence dimension."""
-        return self.sharding(None, "dp", "cp")
+        """Sharding for a [micro, batch, seq] token block: batch over the
+        fused (dp, ep) data axes, sequence over cp. The contiguous
+        per-cp-rank sequence slice the reference does by hand in its collate
+        fn (ref: data.py:105-109) falls out of sharding the sequence
+        dimension."""
+        return self.sharding(None, ("dp", "ep"), "cp")
 
 
 def multihost_initialize() -> None:

@@ -92,6 +92,14 @@ class ParallelCtx:
     # to the input ids (sequence parallelism: tp_size; otherwise 1). Pipeline
     # boundary buffers are sized S_local / seq_shard.
     seq_shard: int = 1
+    # mesh axis for MoE expert parallelism ("ep" inside the composed step);
+    # None = no all_to_all (single device, or outside shard_map)
+    moe_ep_axis: Optional[str] = None
+    # makes the MoE aux-loss scalar tp-INVARIANT under sequence parallelism
+    # (every tp rank computes it from the same gathered tokens, but the
+    # gather's output is typed tp-varying; a pmean re-establishes the
+    # replication so the loss fold stays tp-clean)
+    moe_aux_sync: Callable = _identity
     # gradient checkpointing over decoder layers
     remat: bool = False
     # "full" | "dots" (save matmul outputs, recompute elementwise only)
@@ -125,25 +133,39 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     q_out = cfg.num_attention_heads * d
     kv_out = cfg.num_key_value_heads * d
 
-    keys = jax.random.split(key, 10)
+    keys = jax.random.split(key, 14)
 
     def stacked(k, fan_in, shape):
         ks = jax.random.split(k, nl)
         return jnp.stack([_uniform_fan_in(ks[j], fan_in, shape) for j in range(nl)])
 
-    return {
-        "embedding": jax.random.normal(keys[0], (v, h), jnp.float32),
-        "layers": {
-            "input_norm": jnp.ones((nl, h), jnp.float32),
-            "q": stacked(keys[1], h, (h, q_out)),
-            "k": stacked(keys[2], h, (h, kv_out)),
-            "v": stacked(keys[3], h, (h, kv_out)),
-            "o": stacked(keys[4], q_out, (q_out, h)),
-            "post_norm": jnp.ones((nl, h), jnp.float32),
+    layers = {
+        "input_norm": jnp.ones((nl, h), jnp.float32),
+        "q": stacked(keys[1], h, (h, q_out)),
+        "k": stacked(keys[2], h, (h, kv_out)),
+        "v": stacked(keys[3], h, (h, kv_out)),
+        "o": stacked(keys[4], q_out, (q_out, h)),
+        "post_norm": jnp.ones((nl, h), jnp.float32),
+    }
+    if cfg.num_experts:
+        e, f = cfg.num_experts, cfg.expert_ffn_size
+        layers.update({
+            # router + per-layer expert banks [L, E, ...] (ops/moe.py)
+            "router": stacked(keys[9], h, (h, e)),
+            "w_gate": stacked(keys[5], h, (e, h, f)),
+            "w_up": stacked(keys[6], h, (e, h, f)),
+            "w_down": stacked(keys[7], f, (e, f, h)),
+        })
+    else:
+        layers.update({
             "gate": stacked(keys[5], h, (h, i)),
             "up": stacked(keys[6], h, (h, i)),
             "down": stacked(keys[7], i, (i, h)),
-        },
+        })
+
+    return {
+        "embedding": jax.random.normal(keys[0], (v, h), jnp.float32),
+        "layers": layers,
         "final_norm": jnp.ones((h,), jnp.float32),
         "lm_head": _uniform_fan_in(keys[8], h, (h, v)),
     }
@@ -268,10 +290,32 @@ def _mlp_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx):
     return ctx.g(out)
 
 
+def _moe_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx):
+    """RMSNorm -> top-k routed expert SwiGLU bank (beyond the reference;
+    ops/moe.py). Returns (out, aux_loss)."""
+    from picotron_tpu.ops.moe import moe_mlp
+
+    h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
+    h = ctx.f(h)
+    out, aux = moe_mlp(
+        h, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+        num_experts=cfg.num_experts,
+        top_k=cfg.num_experts_per_token,
+        capacity_factor=cfg.capacity_factor,
+        ep_axis=ctx.moe_ep_axis,
+    )
+    return ctx.g(out), ctx.moe_aux_sync(aux)
+
+
 def decoder_layer(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
+    """Returns (x, aux_loss) — aux is 0 for dense models, the MoE
+    load-balancing term otherwise."""
     x = x + _attention_block(x, lp, cfg, ctx, cos, sin)
-    x = x + _mlp_block(x, lp, cfg, ctx)
-    return x
+    if cfg.num_experts:
+        mlp_out, aux = _moe_block(x, lp, cfg, ctx)
+    else:
+        mlp_out, aux = _mlp_block(x, lp, cfg, ctx), jnp.zeros((), jnp.float32)
+    return x + mlp_out, aux
 
 
 def remat_policy_for(name: str):
@@ -297,20 +341,26 @@ def remat_policy_for(name: str):
 def run_layers(layer_params: Params, x: jnp.ndarray, cfg: ModelConfig,
                ctx: ParallelCtx = DEFAULT_CTX,
                cos: jnp.ndarray | None = None,
-               sin: jnp.ndarray | None = None) -> jnp.ndarray:
+               sin: jnp.ndarray | None = None):
     """Scan a stacked layer pytree over x. Works on any contiguous stage
-    slice, which is exactly what pipeline parallelism feeds it."""
+    slice, which is exactly what pipeline parallelism feeds it.
+
+    Returns (x, aux_loss_sum) — aux is the summed MoE load-balancing loss
+    over the scanned layers (0 for dense models)."""
     if cos is None:
         cos, sin = rope_tables(cfg.max_position_embeddings, cfg.head_dim,
                                cfg.rope_theta)
 
     def body(h, lp):
-        return decoder_layer(h, lp, cfg, ctx, cos, sin), None
+        h, aux = decoder_layer(h, lp, cfg, ctx, cos, sin)
+        # aux rides the scan's stacked outputs (not the carry: its varying
+        # mesh axes differ from x's, which would unstabilize the carry type)
+        return h, aux
 
     if ctx.remat:
         body = jax.checkpoint(body, policy=remat_policy_for(ctx.remat_policy))
-    x, _ = jax.lax.scan(body, x, layer_params)
-    return x
+    x, aux_per_layer = jax.lax.scan(body, x, layer_params)
+    return x, jnp.sum(aux_per_layer)
 
 
 def final_hidden(params: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
@@ -337,7 +387,7 @@ def forward(params: Params, input_ids: jnp.ndarray, cfg: ModelConfig,
     """input_ids [B, S] -> logits [B, S, V] (full vocab; eval/debug path)."""
     cos, sin = rope_tables(cfg.max_position_embeddings, cfg.head_dim, cfg.rope_theta)
     x = embed(params, input_ids, cfg, ctx)
-    x = run_layers(params["layers"], x, cfg, ctx, cos, sin)
+    x, _ = run_layers(params["layers"], x, cfg, ctx, cos, sin)
     x = final_hidden(params, x, cfg)
     return logits_from_hidden(params, x, cfg, ctx)
 
@@ -351,15 +401,25 @@ def loss_sum_count(params: Params, input_ids: jnp.ndarray, targets: jnp.ndarray,
 
     Under TP, `ctx.head_ce` computes the pieces against vocab-sharded logits
     without materializing the full-vocab gather.
+
+    For MoE models the load-balancing aux loss is folded in as
+    `nll_sum + coef * aux * count`, so the downstream `total / count`
+    division yields `ce_mean + coef * aux` — the reported loss includes the
+    aux term (Mixtral convention) and its gradient flows with no extra
+    plumbing through the dp/cp/pp reductions.
     """
     cos, sin = rope_tables(cfg.max_position_embeddings, cfg.head_dim, cfg.rope_theta)
     x = embed(params, input_ids, cfg, ctx)
-    x = run_layers(params["layers"], x, cfg, ctx, cos, sin)
+    x, aux = run_layers(params["layers"], x, cfg, ctx, cos, sin)
     x = final_hidden(params, x, cfg)
     if ctx.head_ce is not None:
-        return ctx.head_ce(x, params["lm_head"], targets)
-    logits = x @ params["lm_head"].astype(x.dtype)
-    return cross_entropy_sum_count(logits, targets)
+        total, count = ctx.head_ce(x, params["lm_head"], targets)
+    else:
+        logits = x @ params["lm_head"].astype(x.dtype)
+        total, count = cross_entropy_sum_count(logits, targets)
+    if cfg.num_experts:
+        total = total + cfg.router_aux_coef * aux * count
+    return total, count
 
 
 def loss_fn(params: Params, input_ids: jnp.ndarray, targets: jnp.ndarray,

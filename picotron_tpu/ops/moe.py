@@ -1,0 +1,159 @@
+"""Mixture-of-experts layer: top-k router + capacity-bounded dispatch +
+expert parallelism over the 'ep' mesh axis.
+
+Beyond the reference (SURVEY §2.2 marks EP/MoE absent) — designed TPU-first:
+
+- **Static shapes** (GShard-style capacity): every expert processes exactly
+  `capacity` token slots per device; overflow tokens are dropped from the
+  expert path (their residual stream passes through unchanged — top-k
+  combine just contributes 0), underflow slots compute on zeros. XLA sees
+  one fixed [E, C, H] einsum program, no data-dependent shapes.
+- **Routing** (Mixtral-style): softmax over the top-k router logits, so the
+  k gates sum to 1 per token. The load-balancing aux loss is the standard
+  Switch/Mixtral `E * sum_e(frac_tokens_e * mean_router_prob_e)`.
+- **Expert parallelism**: the expert bank [E, ...] is sharded over 'ep'
+  (parallel/sharding.py). Dispatch builds per-device [E, C, H] slots, an
+  `all_to_all` over 'ep' regroups them to [E/ep, ep*C, H] so each device
+  runs only its experts over every device's slots, and a reverse
+  `all_to_all` brings expert outputs home. With ep = 1 (or outside
+  shard_map) both collectives are skipped and the math is identical.
+- **TP composes**: the expert ffn dim is sharded over 'tp' like the dense
+  MLP's; the caller's row-parallel exit hook psums the partial outputs.
+
+The dispatch/combine uses scatter/gather by slot index (computed with one
+[N*k, E] cumsum), not the [N, E, C] one-hot einsum of the original GShard —
+the one-hot dispatch tensor is O(N*E*C) memory, which at train shapes
+(N = 6k tokens) dwarfs the activations; slot scatter is O(N*k + E*C).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Routing(NamedTuple):
+    """Per-token routing decisions (all leading dim N = flattened tokens)."""
+
+    expert_idx: jnp.ndarray   # [N, k] int32 — chosen expert per assignment
+    gate: jnp.ndarray         # [N, k] fp32 — combine weight (top-k softmax)
+    slot: jnp.ndarray         # [N, k] int32 — slot within the expert's
+    #                           capacity buffer; >= capacity means dropped
+    aux_loss: jnp.ndarray     # [] fp32 — load-balancing loss
+
+
+def route_topk(logits: jnp.ndarray, k: int) -> Routing:
+    """Top-k routing with slots assigned in token order.
+
+    logits: [N, E] fp32 router outputs. Slot assignment is deterministic in
+    token order (first-come priority); the CALLER drops assignments whose
+    slot lands beyond its capacity (moe_mlp's `keep = slot < cap`).
+    """
+    n, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)   # [N, E]
+    top_p, top_i = lax.top_k(probs, k)                            # [N, k]
+    # Mixtral renormalizes the k selected probabilities to sum to 1.
+    gate = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+
+    # slot_in_expert: for assignment (token t, choice j) -> how many earlier
+    # assignments went to the same expert. Flatten [N, k] in token-major
+    # order, one-hot over E, exclusive cumsum down the assignment axis.
+    flat_e = top_i.reshape(-1)                                    # [N*k]
+    onehot = jax.nn.one_hot(flat_e, e, dtype=jnp.int32)           # [N*k, E]
+    prior = jnp.cumsum(onehot, axis=0) - onehot                   # exclusive
+    slot = jnp.take_along_axis(prior, flat_e[:, None], axis=1)[:, 0]
+    slot = slot.reshape(n, k)
+
+    # Load-balancing aux (Switch eq. 4 / Mixtral): E * sum_e f_e * P_e where
+    # f_e = fraction of assignments routed to e, P_e = mean router prob.
+    f = jnp.mean(jax.nn.one_hot(top_i, e, dtype=jnp.float32), axis=(0, 1))
+    p = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(f * p)
+
+    return Routing(top_i.astype(jnp.int32), gate, slot.astype(jnp.int32), aux)
+
+
+def _swiglu_experts(slots: jnp.ndarray, w_gate, w_up, w_down) -> jnp.ndarray:
+    """Batched SwiGLU over expert slots: [E_local, C', H] with weight banks
+    [E_local, H, F] / [E_local, F, H]. bf16 MXU matmuls, fp32 accumulation
+    folded by XLA; mirrors the dense _mlp_block math."""
+    dt = slots.dtype
+    g = jnp.einsum("ech,ehf->ecf", slots, w_gate.astype(dt))
+    u = jnp.einsum("ech,ehf->ecf", slots, w_up.astype(dt))
+    return jnp.einsum("ecf,efh->ech", jax.nn.silu(g) * u, w_down.astype(dt))
+
+
+def moe_mlp(
+    x: jnp.ndarray,
+    router_w: jnp.ndarray,
+    w_gate: jnp.ndarray,
+    w_up: jnp.ndarray,
+    w_down: jnp.ndarray,
+    *,
+    num_experts: int,
+    top_k: int,
+    capacity_factor: float = 1.25,
+    ep_axis: Optional[str] = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """MoE feed-forward. x: [B, S, H]; router_w: [H, E]; expert banks
+    [E_local, H, F] / [E_local, F, H] (E_local = E/ep under expert
+    parallelism — the bank arrives pre-sharded inside shard_map).
+
+    Returns (out [B, S, H] — partial over tp like the dense down-proj,
+    aux_loss []). `ep_axis` names the mesh axis for the all_to_all pair;
+    None = no expert parallelism (single device, or ep = 1).
+    """
+    b, s, h = x.shape
+    n = b * s
+    e = num_experts
+    ep = lax.psum(1, ep_axis) if ep_axis is not None else 1
+    e_local = w_gate.shape[0]
+    assert e_local * ep == e, (e_local, ep, e)
+    # Per-device capacity per expert, padded to a lane-friendly multiple.
+    cap = int(capacity_factor * top_k * n / e) + 1
+    cap = -(-cap // 8) * 8
+
+    flat = x.reshape(n, h)
+    logits = (flat.astype(jnp.float32)
+              @ router_w.astype(jnp.float32))                     # [N, E] fp32
+    r = route_topk(logits, top_k)
+
+    # ---- dispatch: scatter assignments into [E, cap, H] slot buffers ----
+    keep = r.slot < cap                                           # [N, k]
+    eidx = r.expert_idx.reshape(-1)                               # [N*k]
+    sidx = jnp.where(keep, r.slot, cap - 1).reshape(-1)
+    kflat = keep.reshape(-1)
+    tok = jnp.repeat(jnp.arange(n), top_k)                        # [N*k]
+    buf = jnp.zeros((e, cap, h), x.dtype)
+    buf = buf.at[eidx, sidx].add(
+        flat[tok] * kflat[:, None].astype(x.dtype), mode="drop")
+
+    # ---- expert parallelism: regroup slots so each device runs only its
+    # local experts over every ep-peer's slots ----
+    if ep_axis is not None and ep > 1:
+        # [E, cap, H] -> split E into (ep, E_local) -> all_to_all: trade the
+        # ep groups so this device holds [E_local, ep*cap, H].
+        buf = buf.reshape(ep, e_local, cap, h)
+        buf = lax.all_to_all(buf, ep_axis, split_axis=0, concat_axis=0,
+                             tiled=False)                         # [ep, El, cap, H]
+        buf = jnp.moveaxis(buf, 0, 1).reshape(e_local, ep * cap, h)
+
+    out_slots = _swiglu_experts(buf, w_gate, w_up, w_down)
+
+    if ep_axis is not None and ep > 1:
+        out_slots = out_slots.reshape(e_local, ep, cap, h)
+        out_slots = jnp.moveaxis(out_slots, 1, 0)                 # [ep, El, cap, H]
+        out_slots = lax.all_to_all(out_slots, ep_axis, split_axis=0,
+                                   concat_axis=0, tiled=False)
+        out_slots = out_slots.reshape(e, cap, h)
+
+    # ---- combine: gather each assignment's slot, weight by its gate.
+    # tok is arange(n) repeated k times in order, so the "scatter-add back
+    # to tokens" is just a dense sum over the k assignment column ----
+    picked = out_slots[eidx, sidx]                                # [N*k, H]
+    w = (r.gate.reshape(-1) * kflat).astype(x.dtype)[:, None]
+    out = (picked * w).reshape(n, top_k, h).sum(axis=1)
+    return out.reshape(b, s, h), r.aux_loss

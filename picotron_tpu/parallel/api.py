@@ -133,16 +133,36 @@ def make_parallel_ctx(cfg: Config) -> ParallelCtx:
                                  scatter_seq=True),
             head_ce=lambda x, head, tgt: ce(sp_gather_seq(x), head, tgt),
             seq_shard=d.tp_size,
+            # all tp ranks compute the same aux from the gathered tokens;
+            # pmean re-marks it tp-invariant for the loss fold
+            moe_aux_sync=lambda a: lax.pmean(a, "tp"),
         )
 
     return ParallelCtx(
         attn=attn,
         gather_logits=partial(gather_logits, axis="tp"),
         positions=positions,
+        moe_ep_axis="ep",
         remat=cfg.training.remat,
         remat_policy=cfg.training.remat_policy,
         **hooks,
     )
+
+
+def _data_axes_psum(grads, cfg: Config):
+    """Sum grads over the data axes. 'ep' is a data axis for every param
+    EXCEPT the expert banks sharded over it — their per-device grads already
+    integrate every peer's tokens via the dispatch all_to_all, so an ep psum
+    would multiply them by ep_size."""
+    specs = param_specs(cfg)
+
+    def red(g, spec):
+        flat = [a for part in spec if part is not None
+                for a in (part if isinstance(part, (tuple, list)) else (part,))]
+        axes = ("dp", "cp") if "ep" in flat else ("dp", "ep", "cp")
+        return lax.psum(g, axes)
+
+    return jax.tree.map(red, grads, specs, is_leaf=lambda x: isinstance(x, P))
 
 
 def _device_grads(params, batch, cfg: Config):
@@ -178,9 +198,9 @@ def _device_grads(params, batch, cfg: Config):
         grads = sync_pp_replicated_grads(grads, param_specs(cfg))
         if cfg.distributed.sequence_parallel:
             grads = sync_sp_partial_grads(grads, params)
-        grads = lax.psum(grads, ("dp", "cp"))
-        nll_total = lax.psum(nll_total, ("dp", "cp"))
-        count = jnp.maximum(lax.psum(count, ("dp", "cp")), 1)
+        grads = _data_axes_psum(grads, cfg)
+        nll_total = lax.psum(nll_total, ("dp", "ep", "cp"))
+        count = jnp.maximum(lax.psum(count, ("dp", "ep", "cp")), 1)
         return jax.tree.map(lambda g: g / count, grads), nll_total / count
 
     def nll_sum(params, mb_ids, mb_tgt):
@@ -195,19 +215,23 @@ def _device_grads(params, batch, cfg: Config):
         return (jax.tree.map(jnp.add, g_acc, grads), l_acc + total,
                 c_acc + count), None
 
-    # The accumulators become dp/cp-varying inside the scan (they depend on
-    # this device's batch shard), so the initial carry must carry the same
-    # varying type.
-    zeros = jax.tree.map(jnp.zeros_like, params)
-    init_carry = lax.pcast(
-        (zeros, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
-        ("dp", "cp"), to="varying")
+    # The accumulators become dp/ep/cp-varying inside the scan (they depend
+    # on this device's batch shard), so the initial carry must carry the
+    # same varying type. Promote per leaf, skipping axes a leaf already
+    # varies over (expert banks arrive ep-varying from their sharding).
+    from picotron_tpu.parallel.pp import _vary_over
+
+    zeros = jax.tree.map(
+        lambda p: _vary_over(jnp.zeros_like(p), {"dp", "ep", "cp"}), params)
+    init_carry = (zeros,) + lax.pcast(
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        ("dp", "ep", "cp"), to="varying")
     (grads, nll_total, count), _ = lax.scan(micro_step, init_carry, (ids, tgt))
     # gradient + loss sync over the fused data axes (the reference's cp_dp
     # group semantics: ref process_group_manager.py:22, utils.py:93-98)
-    grads = lax.psum(grads, ("dp", "cp"))
-    nll_total = lax.psum(nll_total, ("dp", "cp"))
-    count = jnp.maximum(lax.psum(count, ("dp", "cp")), 1)
+    grads = _data_axes_psum(grads, cfg)
+    nll_total = lax.psum(nll_total, ("dp", "ep", "cp"))
+    count = jnp.maximum(lax.psum(count, ("dp", "ep", "cp")), 1)
     grads = jax.tree.map(lambda g: g / count, grads)
     loss = nll_total / count
     return grads, loss

@@ -64,7 +64,7 @@ def _vary_over(x, want):
     already varies over). Sound in the safe direction only: it forgets
     replication knowledge, never asserts it."""
     have = jax.typeof(x).vma
-    missing = tuple(a for a in ("dp", "pp", "cp", "tp")
+    missing = tuple(a for a in ("dp", "pp", "ep", "cp", "tp")
                     if a in want and a not in have)
     return lax.pcast(x, missing, to="varying") if missing else x
 
@@ -77,7 +77,7 @@ def _boundary_axes(ctx) -> tuple:
     """Mesh axes the pipeline's activation boundary buffers vary over. A
     seq-sharded residual stream (sequence parallelism) is tp-VARYING; the
     nll/count scalars never are (head_ce psums over tp)."""
-    return ("dp", "cp", "pp") + (("tp",) if ctx.seq_shard > 1 else ())
+    return ("dp", "ep", "cp", "pp") + (("tp",) if ctx.seq_shard > 1 else ())
 
 
 def _make_stage_fn(ids, tgt, m, ctx: ParallelCtx, cos, sin, s_idx, pp):
@@ -99,14 +99,22 @@ def _make_stage_fn(ids, tgt, m, ctx: ParallelCtx, cos, sin, s_idx, pp):
         # finite — no NaNs can poison the masked accumulators' grads).
         x0 = embed(params, mb_ids, m, ctx) * valid.astype(dtype)
         x_in = jnp.where(s_idx == 0, x0, x_buf)
-        y = run_layers(params["layers"], x_in, m, ctx, cos, sin)
+        y, aux = run_layers(params["layers"], x_in, m, ctx, cos, sin)
         hf = final_hidden(params, y, m)
         if ctx.head_ce is not None:
             total, count = ctx.head_ce(hf, params["lm_head"], mb_tgt)
         else:
             logits = hf @ params["lm_head"].astype(hf.dtype)
             total, count = cross_entropy_sum_count(logits, mb_tgt)
-        return (y, total), count
+        # `contrib` is stage-additive: the CE sum counts only on the last
+        # stage (masked HERE, so the engines accumulate on every active
+        # tick), while each stage contributes its own layers' MoE aux loss
+        # weighted by the microbatch token count (llama.loss_sum_count's
+        # folding rule) — psum over 'pp' then assembles the full total.
+        contrib = jnp.where(s_idx == pp - 1, total, 0.0)
+        if m.num_experts:
+            contrib = contrib + m.router_aux_coef * aux * count
+        return (y, contrib), count
 
     return stage_fn
 
@@ -142,10 +150,11 @@ def pipeline_loss_sum_count(params, ids, tgt, cfg: Config, ctx: ParallelCtx):
         d = t - s_idx  # microbatch index this stage works on at tick t
         on = (d >= 0) & (d < n_micro)
         m_f = jnp.clip(d, 0, n_micro - 1)
-        (y, nll), cnt = stage_fn(params, x_buf, m_f, on)
-        take = on & (s_idx == pp - 1)
-        nll_acc = nll_acc + jnp.where(take, nll, 0.0)
-        cnt_acc = cnt_acc + jnp.where(take, cnt, 0)
+        (y, contrib), cnt = stage_fn(params, x_buf, m_f, on)
+        # contrib is pre-masked to the last stage's CE (+ this stage's MoE
+        # aux) inside stage_fn — accumulate wherever the stage was active.
+        nll_acc = nll_acc + jnp.where(on, contrib, 0.0)
+        cnt_acc = cnt_acc + jnp.where(on & (s_idx == pp - 1), cnt, 0)
         y_next = lax.ppermute(y * on.astype(y.dtype), "pp", fwd_perm)
         return (y_next, nll_acc, cnt_acc), None
 
@@ -160,7 +169,7 @@ def pipeline_loss_sum_count(params, ids, tgt, cfg: Config, ctx: ParallelCtx):
         _boundary_axes(ctx), to="varying")
     init = (x0_buf,) + lax.pcast(
         (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
-        ("dp", "cp", "pp"), to="varying")
+        ("dp", "ep", "cp", "pp"), to="varying")
     (x_last, nll_sum, cnt), _ = lax.scan(body, init, jnp.arange(n_ticks))
 
     # Broadcast the last stage's totals to every stage (masked elsewhere, so
@@ -216,10 +225,11 @@ def pipeline_1f1b_grads(params, ids, tgt, cfg: Config, ctx: ParallelCtx):
         df = t - s_idx
         f_on = (df >= 0) & (df % 2 == 0) & (df < 2 * n_micro)
         m_f = jnp.clip(df // 2, 0, n_micro - 1)
-        (y, nll), cnt = stage_fn(params, x_buf, m_f, f_on)
-        take = f_on & (s_idx == pp - 1)
-        nll_acc = nll_acc + jnp.where(take, nll, 0.0)
-        cnt_acc = cnt_acc + jnp.where(take, cnt, 0)
+        (y, contrib), cnt = stage_fn(params, x_buf, m_f, f_on)
+        # contrib pre-masks the CE to the last stage (stage_fn); MoE aux
+        # contributions ride it on every stage.
+        nll_acc = nll_acc + jnp.where(f_on, contrib, 0.0)
+        cnt_acc = cnt_acc + jnp.where(f_on & (s_idx == pp - 1), cnt, 0)
         # Save this stage's *input* for the backward recompute. Guard the
         # store: on non-forward ticks m_f aliases a possibly-live slot.
         ring_new = lax.dynamic_update_index_in_dim(ring, x_buf, m_f % pp, 0)
@@ -236,11 +246,13 @@ def pipeline_1f1b_grads(params, ids, tgt, cfg: Config, ctx: ParallelCtx):
             has_aux=True)
         # Cotangents: g_buf arrived from stage s+1 (zeros at the last stage
         # by ppermute's edge semantics — its y has no downstream consumer);
-        # the loss cotangent is 1 only where the last stage scored m_b. On
-        # non-backward ticks both cotangents are zero, so the VJP outputs
-        # are zero and need no masking.
-        g_nll = _vary_over(jnp.where(b_on & (s_idx == pp - 1), 1.0, 0.0),
-                           {"dp", "cp", "pp"})
+        # the contrib cotangent is 1 on EVERY stage that ran m_b — contrib
+        # masks the CE to the last stage internally, and the per-stage MoE
+        # aux term needs its gradient from every stage. On non-backward
+        # ticks both cotangents are zero, so the VJP outputs are zero and
+        # need no masking.
+        g_nll = _vary_over(jnp.where(b_on, 1.0, 0.0),
+                           {"dp", "ep", "cp", "pp"})
         g_params, g_x = vjp_fn((g_buf, g_nll))
         g_acc = jax.tree.map(
             lambda a, g: jnp.add(a, _cast_varying_like(g, a)), g_acc, g_params)
@@ -254,7 +266,7 @@ def pipeline_1f1b_grads(params, ids, tgt, cfg: Config, ctx: ParallelCtx):
         _boundary_axes(ctx), to="varying"
     ) + lax.pcast(
         (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
-        ("dp", "cp", "pp"), to="varying")
+        ("dp", "ep", "cp", "pp"), to="varying")
     # Each grad-accumulator leaf varies over the data axes plus whatever its
     # param already varies over (tp/pp shardings) — matching what the VJP
     # emits each tick, so the scan carry type is stable. Under sequence

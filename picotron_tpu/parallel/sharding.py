@@ -32,19 +32,33 @@ def param_specs(cfg: Config) -> dict[str, Any]:
     """PartitionSpec pytree matching models.llama.init_params' structure."""
     # layers % pp divisibility is enforced by Config.validate().
     pp = "pp" if cfg.distributed.pp_size > 1 else None
-    return {
-        "embedding": P("tp", None),
-        "layers": {
-            "input_norm": P(pp, None),
-            "q": P(pp, None, "tp"),
-            "k": P(pp, None, "tp"),
-            "v": P(pp, None, "tp"),
-            "o": P(pp, "tp", None),
-            "post_norm": P(pp, None),
+    layers = {
+        "input_norm": P(pp, None),
+        "q": P(pp, None, "tp"),
+        "k": P(pp, None, "tp"),
+        "v": P(pp, None, "tp"),
+        "o": P(pp, "tp", None),
+        "post_norm": P(pp, None),
+    }
+    if cfg.model.num_experts:
+        # expert banks [L, E, ...]: expert dim over 'ep', ffn dim over 'tp'
+        # (column-parallel gate/up, row-parallel down — same as the dense
+        # MLP); the router is small and replicated.
+        layers.update({
+            "router": P(pp, None, None),
+            "w_gate": P(pp, "ep", None, "tp"),
+            "w_up": P(pp, "ep", None, "tp"),
+            "w_down": P(pp, "ep", "tp", None),
+        })
+    else:
+        layers.update({
             "gate": P(pp, None, "tp"),
             "up": P(pp, None, "tp"),
             "down": P(pp, "tp", None),
-        },
+        })
+    return {
+        "embedding": P("tp", None),
+        "layers": layers,
         "final_norm": P(),
         "lm_head": P(None, "tp"),
     }
@@ -53,7 +67,7 @@ def param_specs(cfg: Config) -> dict[str, Any]:
 def batch_spec() -> P:
     """[n_micro, batch, seq] token blocks: batch over dp, sequence over cp
     (the contiguous CP split, ref: data.py:105-109, as a sharding)."""
-    return P(None, "dp", "cp")
+    return P(None, ("dp", "ep"), "cp")
 
 
 def param_shardings(cfg: Config, mesh) -> dict[str, Any]:

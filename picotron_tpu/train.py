@@ -83,7 +83,7 @@ def main(argv=None) -> None:
     peak = device_peak_flops()
     log_print(
         f"model {cfg.model.name}: {human_format(n_params)} params | "
-        f"mesh dp={menv.dp} pp={menv.pp} cp={menv.cp} tp={menv.tp} "
+        f"mesh dp={menv.dp} pp={menv.pp} ep={menv.ep} cp={menv.cp} tp={menv.tp} "
         f"({n_chips} chips, {jax.devices()[0].device_kind}) | "
         f"global batch {cfg.global_batch_size} x seq {t.seq_length} = "
         f"{human_format(cfg.tokens_per_step)} tokens/step"

@@ -106,6 +106,23 @@ def test_hf_safetensors_roundtrip(tmp_path):
     assert bool(jnp.isfinite(logits).all())
 
 
+def test_hf_safetensors_roundtrip_moe(tmp_path):
+    """Mixtral-style MoE naming (block_sparse_moe.gate /
+    experts.<j>.{w1,w2,w3}) round-trips through export -> import."""
+    cfg = ModelConfig(dtype="float32", num_experts=4, num_experts_per_token=2)
+    params = init_params(cfg, jax.random.key(5))
+    save_hf_safetensors(params, str(tmp_path / "hf"))
+    # spot-check the on-disk naming is Mixtral's
+    from safetensors.numpy import load_file
+    tensors = load_file(str(tmp_path / "hf" / "model.safetensors"))
+    assert "model.layers.0.block_sparse_moe.gate.weight" in tensors
+    assert "model.layers.0.block_sparse_moe.experts.3.w2.weight" in tensors
+    loaded = load_hf_safetensors(str(tmp_path / "hf"), cfg)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        params, loaded)
+
+
 def test_tied_head_checkpoint_unties(tmp_path):
     """A checkpoint without lm_head.weight falls back to embedding^T
     (ref: checkpoint.py:88-91 force-creates the untied head)."""

@@ -34,6 +34,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1)
     p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert parallelism (MoE models only)")
     p.add_argument("--pp-engine", default="1f1b", choices=["1f1b", "afab"])
     p.add_argument("--sequence-parallel", action="store_true",
                    help="Megatron-SP over the tp axis (seq-sharded "
@@ -90,7 +92,8 @@ def create_single_config(args) -> str:
     raw = {
         "distributed": {
             "tp_size": args.tp, "cp_size": args.cp, "pp_size": args.pp,
-            "dp_size": args.dp, "pp_engine": args.pp_engine,
+            "dp_size": args.dp, "ep_size": args.ep,
+            "pp_engine": args.pp_engine,
             "sequence_parallel": args.sequence_parallel,
             "use_cpu": args.use_cpu,
         },
@@ -131,10 +134,12 @@ def create_single_config(args) -> str:
 
     # ref: create_config.py:71-73 prints the same math
     print(f"config -> {path}")
-    print(f"  mesh: dp={args.dp} pp={args.pp} cp={args.cp} tp={args.tp} "
+    print(f"  mesh: dp={args.dp} pp={args.pp} ep={args.ep} cp={args.cp} tp={args.tp} "
           f"({cfg.distributed.world_size} chips)")
+    dataxes = (f"x dp {args.dp} x ep {args.ep}" if args.ep > 1
+               else f"x dp {args.dp}")
     print(f"  global_batch_size = mbs {args.mbs} x grad_acc {args.grad_acc} "
-          f"x dp {args.dp} = {cfg.global_batch_size} "
+          f"{dataxes} = {cfg.global_batch_size} "
           f"({cfg.tokens_per_step} tokens/step)")
     return path
 
