@@ -223,9 +223,10 @@ class ModelConfig:
         return self.moe_intermediate_size or self.intermediate_size
 
     def validate(self) -> None:
-        if self.attn_impl not in ("auto", "flash", "reference", "ring"):
+        if self.attn_impl not in ("auto", "flash", "reference", "ring",
+                                  "ulysses"):
             raise ValueError(
-                f"attn_impl must be one of auto/flash/reference/ring, got "
+                f"attn_impl must be one of auto/flash/reference/ring/ulysses, got "
                 f"{self.attn_impl!r}"
             )
         if self.hidden_size % self.num_attention_heads != 0:
@@ -343,6 +344,14 @@ class Config:
             raise ValueError("num_key_value_heads must be divisible by tp_size")
         if m.vocab_size % d.tp_size != 0:
             raise ValueError("vocab_size must be divisible by tp_size")
+        if m.attn_impl == "ulysses" and d.cp_size > 1:
+            if (m.num_attention_heads // d.tp_size) % d.cp_size != 0 or (
+                    m.num_key_value_heads // d.tp_size) % d.cp_size != 0:
+                raise ValueError(
+                    "attn_impl='ulysses' scatters the tp-local heads over "
+                    "cp: num_attention_heads/tp and num_key_value_heads/tp "
+                    f"must be divisible by cp_size ({d.cp_size}); use "
+                    "attn_impl='ring' for head counts that do not divide")
         if d.ep_size > 1 and m.num_experts == 0:
             raise ValueError(
                 "ep_size > 1 requires a mixture-of-experts model "

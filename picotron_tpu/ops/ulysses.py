@@ -1,0 +1,92 @@
+"""Ulysses (DeepSpeed-style) all-to-all sequence parallelism.
+
+An alternative context-parallel schedule to the K/V ring
+(ops/ring_attention.py): instead of rotating K/V blocks around the 'cp'
+ring for cp steps, ONE all_to_all pair per attention call trades the
+sequence sharding for a head sharding —
+
+    q/k/v [B, S/cp, H, D]  --all_to_all-->  [B, S, H/cp, D]
+
+so each device runs ordinary full-sequence attention (the Pallas flash
+kernel, fused RoPE and all) over its head subset, and the output rides the
+reverse all_to_all home. Communication volume per call is 2x activations
+(vs the ring's (cp-1)/cp x K/V per step but cp steps), and the attention
+itself needs no LSE merging or causal-step bookkeeping.
+
+Positions travel via an all_gather so any sequence layout works — with the
+zigzag CP layout the gathered sequence is position-permuted and the
+position-masked flash kernel handles it unchanged.
+
+Constraint: local head counts (after TP) must be divisible by cp — q AND kv
+heads (GQA); config.validate enforces it. The ring has no such constraint,
+which is why both schedules exist (`attn_impl: "ring" | "ulysses"`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _scatter_heads(x: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """[B, S_local, H, D] -> [B, S, H/cp, D]: split heads over `axis`,
+    concatenate the sequence shards (in device order)."""
+    return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+
+def _gather_heads(x: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """Inverse of _scatter_heads: [B, S, H/cp, D] -> [B, S_local, H, D]."""
+    return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis: str = "cp",
+    q_positions: Optional[jnp.ndarray] = None,
+    attn_fn: Callable,
+    rope=None,
+    seq_sort=None,
+) -> jnp.ndarray:
+    """Full-sequence attention over seq-sharded q/k/v [B, S_local, H, D].
+
+    attn_fn(q, k, v, causal=True, q_positions=..., kv_positions=..., \
+            rope=...) runs the per-device attention (flash_attention — gets
+    the fused-RoPE path; its position-based causal mask handles any
+    gathered sequence order).
+
+    seq_sort: optional static [S] permutation sorting the GATHERED sequence
+    by global position. Under the zigzag cp layout the gathered order is
+    position-interleaved, which would leave almost every attention tile
+    position-straddling (defeating the flash kernel's unmasked fast path
+    and block skipping); the layout permutation is known at trace time, so
+    sorting costs two static gathers and restores ring-free full-sequence
+    attention on a monotone sequence. The caller (parallel/api.py) derives
+    it from the configured cp layout.
+    """
+    s_local = q.shape[1]
+    if q_positions is None:
+        # this shard's contiguous slice of the global sequence (same
+        # default as ring_attention)
+        q_positions = lax.axis_index(axis) * s_local + jnp.arange(s_local)
+    # positions of the gathered sequence, in the same device-order the
+    # all_to_all concatenates shards
+    pos_full = lax.all_gather(q_positions, axis, axis=0, tiled=True)
+
+    qh = _scatter_heads(q, axis)
+    kh = _scatter_heads(k, axis)
+    vh = _scatter_heads(v, axis)
+    if seq_sort is not None:
+        inv = jnp.argsort(jnp.asarray(seq_sort))
+        pos_full = pos_full[seq_sort]
+        qh, kh, vh = (x[:, seq_sort] for x in (qh, kh, vh))
+    kwargs = {} if rope is None else {"rope": rope}
+    out = attn_fn(qh, kh, vh, causal=True, q_positions=pos_full,
+                  kv_positions=pos_full, **kwargs)
+    if seq_sort is not None:
+        out = out[:, inv]
+    return _gather_heads(out, axis)

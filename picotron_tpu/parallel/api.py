@@ -70,18 +70,38 @@ def make_parallel_ctx(cfg: Config) -> ParallelCtx:
     # FLASH_ATTEN / CONTEXT_PARALLEL env vars, ref: model.py:148-158):
     # flash = the Pallas kernel on TPU (jnp twin elsewhere), reference = the
     # plain jnp softmax path, ring = require context parallelism.
-    if cfg.model.attn_impl == "ring" and d.cp_size == 1:
+    if cfg.model.attn_impl in ("ring", "ulysses") and d.cp_size == 1:
         raise ValueError(
-            "attn_impl='ring' requires cp_size > 1 (ring attention is the "
-            "context-parallel schedule; ref: context_parallel.py:10-12)"
+            f"attn_impl={cfg.model.attn_impl!r} requires cp_size > 1 (it is "
+            "a context-parallel schedule; ref: context_parallel.py:10-12)"
         )
-    use_flash = cfg.model.attn_impl in ("auto", "flash", "ring")
+    use_flash = cfg.model.attn_impl in ("auto", "flash", "ring", "ulysses")
     if use_flash:
         from picotron_tpu.ops.flash_attention import flash_attention as attn_fn
     else:
         from picotron_tpu.ops.attention import sdpa_attention as attn_fn
 
-    if d.cp_size > 1:
+    if d.cp_size > 1 and cfg.model.attn_impl == "ulysses":
+        import numpy as np
+
+        from picotron_tpu.data import cp_sequence_permutation
+        from picotron_tpu.ops.ulysses import ulysses_attention
+
+        # the gathered sequence's global positions are exactly the
+        # dataloader's layout permutation; a static argsort restores a
+        # monotone sequence so the kernel's causal fast paths fire
+        layout_perm = cp_sequence_permutation(cfg)
+        seq_sort = (np.argsort(np.asarray(layout_perm))
+                    if layout_perm is not None else None)
+
+        def attn(q, k, v, pos, rope):
+            # one all_to_all pair trades the seq shard for a head shard;
+            # the flash kernel (fused RoPE, position-masked causal) then
+            # runs full-sequence on this device's head subset (ops/ulysses)
+            return ulysses_attention(q, k, v, axis="cp", q_positions=pos,
+                                     attn_fn=attn_fn, rope=rope,
+                                     seq_sort=seq_sort)
+    elif d.cp_size > 1:
         from picotron_tpu.ops.ring_attention import ring_attention
         from picotron_tpu.ops.rope import apply_rope
 

@@ -23,11 +23,13 @@ from picotron_tpu.train_step import init_train_state, make_train_step as make_si
 def tiny_cfg(**dist) -> Config:
     gas = dist.pop("gas", 2)
     layers = dist.pop("layers", 4)
+    attn_impl = dist.pop("attn_impl", "auto")
     return Config(
         distributed=DistributedConfig(**dist),
         # 8 q heads / 4 kv heads so GQA survives tp up to 4
         model=ModelConfig(dtype="float32", num_attention_heads=8,
-                          num_key_value_heads=4, num_hidden_layers=layers),
+                          num_key_value_heads=4, num_hidden_layers=layers,
+                          attn_impl=attn_impl),
         training=TrainingConfig(seq_length=32, micro_batch_size=2,
                                 gradient_accumulation_steps=gas,
                                 learning_rate=1e-3, remat=False),
@@ -104,6 +106,12 @@ def run_single(cfg_parallel, steps=3):
     dict(pp_size=4, layers=5, gas=4, tp_size=2),
     dict(dp_size=2, pp_size=2, cp_size=2),
     dict(dp_size=2, pp_size=2, tp_size=2),
+    # Ulysses all-to-all sequence parallelism: head-scatter instead of the
+    # K/V ring, same numbers (zigzag layout still applies)
+    dict(cp_size=4, attn_impl="ulysses"),
+    dict(cp_size=2, dp_size=2, attn_impl="ulysses"),
+    dict(cp_size=2, tp_size=2, attn_impl="ulysses"),
+    dict(cp_size=2, tp_size=2, attn_impl="ulysses", sequence_parallel=True),
     # Megatron-style sequence parallelism over tp (seq-sharded residual
     # stream, all_gather/reduce-scatter f/g) must be numerically invisible
     dict(tp_size=4, sequence_parallel=True),

@@ -48,7 +48,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-attention-heads", type=int, default=None)
     p.add_argument("--num-key-value-heads", type=int, default=None)
     p.add_argument("--attn-impl", default="auto",
-                   choices=["auto", "flash", "reference", "ring"])
+                   choices=["auto", "flash", "reference", "ring", "ulysses"])
     p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
     # training (ref: create_config.py --mbs/--grad-acc/--seq-len)
     p.add_argument("--mbs", type=int, default=1)
