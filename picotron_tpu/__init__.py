@@ -1,10 +1,10 @@
-"""picotron-tpu: a TPU-native 4D-parallel LLM pre-training framework.
+"""picotron-tpu: a TPU-native 5D-parallel LLM pre-training framework.
 
 A from-scratch JAX/XLA/Pallas framework with the capabilities of the
 reference `picotron` (HuggingFace's minimalist 4D-parallel trainer), designed
 SPMD/compiler-first for TPU:
 
-- one `jax.sharding.Mesh` with axes ``('dp', 'pp', 'cp', 'tp')`` replaces the
+- one `jax.sharding.Mesh` with axes ``('dp', 'pp', 'ep', 'cp', 'tp')`` replaces the
   per-rank process-group singleton (ref: picotron/process_group_manager.py),
 - data / tensor / pipeline / context parallelism are composed inside a single
   `shard_map`-ped train step with explicit XLA collectives

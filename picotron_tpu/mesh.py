@@ -154,12 +154,19 @@ def multihost_initialize() -> None:
 
     if _jdist.global_state.client is not None:
         return  # already initialized
-    cluster_env = (
-        os.environ.get("COORDINATOR_ADDRESS")
-        or os.environ.get("JAX_COORDINATOR_ADDRESS")
-        or os.environ.get("SLURM_JOB_ID")
-        or os.environ.get("OMPI_COMM_WORLD_SIZE")
-        or os.environ.get("TPU_WORKER_HOSTNAMES", "").count(",") > 0
-    )
-    if cluster_env:
+    if _cluster_env_detected(os.environ):
         jax.distributed.initialize()
+
+
+def _cluster_env_detected(env) -> bool:
+    """True when a multi-host cluster environment is plausibly present:
+    an explicit coordinator address, a SLURM/OpenMPI job, or a Cloud TPU
+    pod worker list with more than one host. Single-host runs (including
+    a TPU_WORKER_HOSTNAMES containing just this host) stay local."""
+    if env.get("COORDINATOR_ADDRESS") or env.get("JAX_COORDINATOR_ADDRESS"):
+        return True
+    if env.get("SLURM_JOB_ID") or env.get("OMPI_COMM_WORLD_SIZE"):
+        return True
+    hosts = [h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",")
+             if h.strip()]
+    return len(hosts) > 1

@@ -51,6 +51,7 @@ def ulysses_attention(
     attn_fn: Callable,
     rope=None,
     seq_sort=None,
+    full_positions=None,
 ) -> jnp.ndarray:
     """Full-sequence attention over seq-sharded q/k/v [B, S_local, H, D].
 
@@ -67,15 +68,23 @@ def ulysses_attention(
     sorting costs two static gathers and restores ring-free full-sequence
     attention on a monotone sequence. The caller (parallel/api.py) derives
     it from the configured cp layout.
+
+    full_positions: optional static [S] global positions of the gathered
+    (device-order) sequence — when the layout is known at trace time
+    (parallel/api.py passes it), this skips a per-call all_gather of
+    positions in the jitted hot path.
     """
     s_local = q.shape[1]
-    if q_positions is None:
-        # this shard's contiguous slice of the global sequence (same
-        # default as ring_attention)
-        q_positions = lax.axis_index(axis) * s_local + jnp.arange(s_local)
-    # positions of the gathered sequence, in the same device-order the
-    # all_to_all concatenates shards
-    pos_full = lax.all_gather(q_positions, axis, axis=0, tiled=True)
+    if full_positions is not None:
+        pos_full = jnp.asarray(full_positions)
+    else:
+        if q_positions is None:
+            # this shard's contiguous slice of the global sequence (same
+            # default as ring_attention)
+            q_positions = lax.axis_index(axis) * s_local + jnp.arange(s_local)
+        # positions of the gathered sequence, in the same device-order the
+        # all_to_all concatenates shards
+        pos_full = lax.all_gather(q_positions, axis, axis=0, tiled=True)
 
     qh = _scatter_heads(q, axis)
     kh = _scatter_heads(k, axis)

@@ -1,4 +1,4 @@
-"""The composed parallel train step — one SPMD program over the 4D mesh.
+"""The composed parallel train step — one SPMD program over the 5D mesh.
 
 This is the TPU-native replacement for the reference's entire L4/L5 wiring
 (apply_tensor_parallel -> PipelineParallel -> apply_context_parallel ->
@@ -88,10 +88,14 @@ def make_parallel_ctx(cfg: Config) -> ParallelCtx:
         from picotron_tpu.ops.ulysses import ulysses_attention
 
         # the gathered sequence's global positions are exactly the
-        # dataloader's layout permutation; a static argsort restores a
-        # monotone sequence so the kernel's causal fast paths fire
+        # dataloader's layout permutation (arange when contiguous) — known
+        # at trace time, so no runtime position all_gather is needed, and a
+        # static argsort restores a monotone sequence so the kernel's
+        # causal fast paths fire
         layout_perm = cp_sequence_permutation(cfg)
-        seq_sort = (np.argsort(np.asarray(layout_perm))
+        full_pos = (np.asarray(layout_perm) if layout_perm is not None
+                    else np.arange(cfg.training.seq_length))
+        seq_sort = (np.argsort(full_pos)
                     if layout_perm is not None else None)
 
         def attn(q, k, v, pos, rope):
@@ -100,7 +104,8 @@ def make_parallel_ctx(cfg: Config) -> ParallelCtx:
             # runs full-sequence on this device's head subset (ops/ulysses)
             return ulysses_attention(q, k, v, axis="cp", q_positions=pos,
                                      attn_fn=attn_fn, rope=rope,
-                                     seq_sort=seq_sort)
+                                     seq_sort=seq_sort,
+                                     full_positions=full_pos)
     elif d.cp_size > 1:
         from picotron_tpu.ops.ring_attention import ring_attention
         from picotron_tpu.ops.rope import apply_rope
@@ -259,7 +264,7 @@ def _device_grads(params, batch, cfg: Config):
 
 def make_train_step(cfg: Config, menv: MeshEnv):
     """Build the jitted (TrainState, batch) -> (TrainState, loss) step over
-    the 4D mesh. batch = (input_ids, targets), each [n_micro, global_b, seq]
+    the mesh. batch = (input_ids, targets), each [n_micro, global_b, seq]
     sharded P(None, 'dp', 'cp')."""
     cfg.validate()
     mesh = menv.mesh

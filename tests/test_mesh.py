@@ -36,3 +36,35 @@ def test_batch_sharding_slices_seq_over_cp(devices):
     shard = arr.addressable_shards[0]
     assert shard.data.shape == (1, 2, 4)
     np.testing.assert_array_equal(np.asarray(arr), x)
+
+
+def test_cluster_env_detection():
+    from picotron_tpu.mesh import _cluster_env_detected
+
+    assert not _cluster_env_detected({})
+    assert not _cluster_env_detected({"TPU_WORKER_HOSTNAMES": ""})
+    assert not _cluster_env_detected({"TPU_WORKER_HOSTNAMES": "host0"})
+    assert _cluster_env_detected({"TPU_WORKER_HOSTNAMES": "host0,host1"})
+    assert _cluster_env_detected({"COORDINATOR_ADDRESS": "10.0.0.1:1234"})
+    assert _cluster_env_detected({"SLURM_JOB_ID": "42"})
+    assert _cluster_env_detected({"OMPI_COMM_WORLD_SIZE": "4"})
+
+
+def test_multihost_initialize_singlehost_noop():
+    """On a single host (no cluster env), multihost_initialize must be a
+    no-op rather than hanging waiting for a coordinator (SURVEY §2 row 22:
+    the launcher path)."""
+    import os
+
+    from picotron_tpu.mesh import multihost_initialize
+
+    saved = {k: os.environ.pop(k, None) for k in
+             ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+              "SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE",
+              "TPU_WORKER_HOSTNAMES")}
+    try:
+        multihost_initialize()  # returns immediately, initializes nothing
+    finally:
+        for k, v in saved.items():
+            if v is not None:
+                os.environ[k] = v
