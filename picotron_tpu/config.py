@@ -157,6 +157,12 @@ class DistributedConfig:
     # psum they replace, tp x less activation memory at layer boundaries,
     # tp x less pipeline boundary traffic).
     sequence_parallel: bool = False
+    # ZeRO-1 optimizer-state sharding (beyond the reference): shards the
+    # Adam moments over 'dp' in addition to their param's tp/pp/ep
+    # sharding. GSPMD turns the sharding annotation into the per-shard
+    # update + all-gather schedule; with bf16 moments this cuts resident
+    # optimizer memory by ~dp_size.
+    zero1: bool = False
     # Accepted for reference-JSON compatibility; ignored (XLA picks transport).
     backend: str = "jax"
     use_cpu: bool = False

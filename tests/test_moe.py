@@ -166,3 +166,47 @@ def test_moe_layouts_match_single_device(dist):
     # single-device value at O(coef * shard-variance); the CE term matches
     # at the usual 2e-4.
     np.testing.assert_allclose(par_losses, ref_losses, rtol=1e-3, atol=2e-5)
+
+
+def test_zero1_with_ep_shards_moments_over_both_data_axes():
+    """ZeRO-1 under expert parallelism: non-expert moments shard over the
+    fused ('dp','ep') data axes; expert-bank moments (already ep-sharded)
+    gain only 'dp'. Training stays numerically identical."""
+    cfg = moe_cfg(ep_size=2, dp_size=2, zero1=True)
+    cfg.validate()
+    menv = MeshEnv.from_config(cfg)
+    state = init_sharded_state(cfg, menv, jax.random.key(0))
+    step = make_train_step(cfg, menv)
+    ids, tgt = global_batch(cfg)
+    sh = NamedSharding(menv.mesh, P(None, ("dp", "ep"), "cp"))
+    batch = (jax.device_put(ids, sh), jax.device_put(tgt, sh))
+    losses = []
+    for _ in range(3):
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+
+    ref_cfg = Config(model=cfg.model, training=cfg.training)
+    params = init_params(ref_cfg.model, jax.random.key(0))
+    ref_state = init_train_state(ref_cfg, params)
+    ref_step = jax.jit(make_single_step(ref_cfg))
+    ref_losses = []
+    for _ in range(3):
+        ref_state, loss = ref_step(ref_state, (ids, tgt))
+        ref_losses.append(float(loss))
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-3, atol=2e-5)
+
+    def flat_axes(spec):
+        return [a for part in spec if part is not None
+                for a in (part if isinstance(part, (tuple, list)) else (part,))]
+
+    q_shape = state.params["layers"]["q"].shape
+    wg_shape = state.params["layers"]["w_gate"].shape
+    q_specs = [x.sharding.spec for x in jax.tree.leaves(state.opt_state)
+               if getattr(x, "shape", None) == q_shape]
+    wg_specs = [x.sharding.spec for x in jax.tree.leaves(state.opt_state)
+                if getattr(x, "shape", None) == wg_shape]
+    assert q_specs and wg_specs
+    for s in q_specs:  # non-expert: both data axes
+        assert {"dp", "ep"} <= set(flat_axes(s)), s
+    for s in wg_specs:  # expert bank: ep already shards experts; dp added
+        assert "dp" in flat_axes(s), s

@@ -40,6 +40,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sequence-parallel", action="store_true",
                    help="Megatron-SP over the tp axis (seq-sharded "
                         "residual stream between blocks)")
+    p.add_argument("--zero1", action="store_true",
+                   help="ZeRO-1: shard Adam moments over dp")
     # model
     p.add_argument("--model", default="HuggingFaceTB/SmolLM-1.7B")
     p.add_argument("--num-hidden-layers", type=int, default=None,
@@ -95,6 +97,7 @@ def create_single_config(args) -> str:
             "dp_size": args.dp, "ep_size": args.ep,
             "pp_engine": args.pp_engine,
             "sequence_parallel": args.sequence_parallel,
+            "zero1": args.zero1,
             "use_cpu": args.use_cpu,
         },
         "model": {
