@@ -166,14 +166,19 @@ def main() -> None:
         from picotron_tpu.config import resolve_preset
 
         # the matrix pins per-config shape flags; only these compose with it
-        defaults = {"model": "SmolLM-1.7B", "seq": 2048, "mbs": 3,
-                    "grad_acc": 1, "layers": None, "profile": None,
-                    "no_remat": False}
-        clashing = [k for k, v in defaults.items()
-                    if getattr(args, k.replace("-", "_")) != v]
+        # (attr name -> (default, real flag spelling), so the error names
+        # flags the user can actually type; ADVICE r2)
+        defaults = {"model": ("SmolLM-1.7B", "--model"),
+                    "seq": (2048, "--seq"), "mbs": (3, "--mbs"),
+                    "grad_acc": (1, "--grad-acc"),
+                    "layers": (None, "--layers"),
+                    "profile": (None, "--profile"),
+                    "no_remat": (False, "--no-remat")}
+        clashing = [flag for k, (v, flag) in defaults.items()
+                    if getattr(args, k) != v]
         if clashing:
             ap.error(f"--sweep runs a fixed config matrix; incompatible "
-                     f"with: {', '.join('--' + c for c in clashing)}")
+                     f"with: {', '.join(clashing)}")
         for model, layers, seq, mbs in SWEEP:
             depth = layers or resolve_preset(model)["num_hidden_layers"]
             try:

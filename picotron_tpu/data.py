@@ -6,10 +6,15 @@ restructured for a single-controller SPMD runtime:
 - The reference runs one DataLoader per rank with a `DistributedSampler`
   sharded by dp_rank (ref: data.py:40-45) and a collate function that slices
   each sequence to the local cp rank's contiguous chunk (ref: data.py:102-116).
-  Here the host assembles the *global* batch [n_micro, global_batch, seq]
-  and `jax.device_put` with a `P(None, 'dp', 'cp')` sharding hands every
-  device exactly the shard those two mechanisms produced — the dp split on
-  the batch dim, the contiguous cp split on the sequence dim.
+  Here each process assembles the *global* batch [n_micro, global_batch, seq]
+  deterministically (the source is a pure function of (epoch, cursor)) and
+  hands it to the mesh under the `P(None, ('dp','ep'), 'cp')` sharding — the
+  dp split on the batch dim, the cp split on the sequence dim. Single-process,
+  that is one `jax.device_put`; with `jax.process_count() > 1` each process
+  contributes only its addressable shards via `jax.make_array_from_callback`
+  (the per-rank contract the reference's DistributedSampler implements,
+  ref: data.py:40-45 — a plain device_put cannot place data on another
+  host's devices).
 - Tokenizer broadcast via `broadcast_object_list` (ref: data.py:23-32)
   disappears: one process per host means plain host code.
 - `global_batch_size = mbs * grad_acc * dp` and
@@ -171,8 +176,9 @@ class DatasetSource:
 
 class MicroBatchDataLoader:
     """Yields (input_ids, targets) pairs shaped
-    [grad_acc, global_batch, seq_length], device_put into the mesh's
-    P(None, 'dp', 'cp') sharding. Iteration is infinite: exhausting the
+    [grad_acc, global_batch, seq_length], placed into the mesh's
+    P(None, ('dp','ep'), 'cp') sharding (process-local shards only on
+    multi-host runs). Iteration is infinite: exhausting the
     source bumps the epoch and continues (ref: data.py:118-137). The tail of
     each epoch is dropped when len(source) is not a multiple of the global
     batch (up to global_batch - 1 blocks — the reference's drop_last
@@ -268,9 +274,22 @@ class MicroBatchDataLoader:
             # each token still predicts its true successor.
             ids = ids[..., self.cp_perm]
             targets = targets[..., self.cp_perm]
-        batch = (jax.device_put(ids, self.sharding),
-                 jax.device_put(targets, self.sharding))
+        batch = (self._put_sharded(ids), self._put_sharded(targets))
         return batch, {"epoch": self.epoch, "cursor": self.cursor}
+
+    def _put_sharded(self, arr: np.ndarray):
+        """Hand a host-assembled global array to the mesh. Multi-process,
+        `jax.device_put` would have to place shards on non-addressable
+        devices and throws; instead every process runs this same code on the
+        same (deterministic) global batch and `make_array_from_callback`
+        pulls out just the shards its local devices own. Token blocks are
+        int32 and small relative to activations, so the redundant host-side
+        assembly is cheap and keeps the path layout-agnostic (any
+        process->device assignment the runtime picks works)."""
+        if jax.process_count() == 1:
+            return jax.device_put(arr, self.sharding)
+        return jax.make_array_from_callback(
+            arr.shape, self.sharding, lambda idx: arr[idx])
 
     def _produce(self):
         while not self._stop.is_set():

@@ -265,7 +265,7 @@ def _device_grads(params, batch, cfg: Config):
 def make_train_step(cfg: Config, menv: MeshEnv):
     """Build the jitted (TrainState, batch) -> (TrainState, loss) step over
     the mesh. batch = (input_ids, targets), each [n_micro, global_b, seq]
-    sharded P(None, 'dp', 'cp')."""
+    sharded P(None, ('dp', 'ep'), 'cp')."""
     cfg.validate()
     mesh = menv.mesh
     pspecs = param_specs(cfg)

@@ -70,9 +70,23 @@ def main(argv=None) -> None:
         # The reference's --use_cpu path (gloo + FLASH_ATTEN=0, ref:
         # create_config.py:64-66): run the full parallel layout on simulated
         # host devices. Must happen before any backend-initializing jax call.
-        from picotron_tpu.mesh import force_host_device_count
+        # Under the multi-process launcher contract each process provisions
+        # only its share of the world's devices (the 2-process integration
+        # test runs exactly this path). launcher_contract() validates the
+        # PICOTRON_* vars as a unit, so a stale partial contract fails here
+        # rather than as a confusing mesh-oversubscription error.
+        from picotron_tpu.mesh import force_host_device_count, launcher_contract
 
-        force_host_device_count(cfg.distributed.world_size)
+        contract = launcher_contract()
+        n_proc = contract[1] if contract else 1
+        world = cfg.distributed.world_size
+        if world % n_proc != 0:
+            raise ValueError(
+                f"world_size {world} not divisible by "
+                f"PICOTRON_NUM_PROCESSES={n_proc}")
+        # exact under a multi-process contract: an inherited XLA_FLAGS count
+        # would otherwise over-provision every process (code review r3)
+        force_host_device_count(world // n_proc, exact=n_proc > 1)
         jax.config.update("jax_platforms", "cpu")
     multihost_initialize()
     menv = MeshEnv.from_config(cfg)
