@@ -83,6 +83,13 @@ class ParallelCtx:
     # fused head+CE returning (nll_sum, valid_count) (vocab-parallel TP
     # overrides to avoid full-logit gather)
     head_ce: Optional[Callable] = None
+    # collective-free/merge split of head_ce for the pipeline engines' gated
+    # last-stage scoring (parallel/tp.py vocab_parallel_ce_local_stats /
+    # _merge); None when the split is unavailable (sequence parallelism —
+    # its seq gather cannot live inside a divergent branch) and the engines
+    # fall back to uniform masked scoring
+    head_ce_local: Optional[Callable] = None
+    head_ce_merge: Optional[Callable] = None
     # logits gather for eval under TP
     gather_logits: Callable = _identity
     # global positions of this shard's tokens [S_local] (context parallelism;
@@ -95,6 +102,9 @@ class ParallelCtx:
     # mesh axis for MoE expert parallelism ("ep" inside the composed step);
     # None = no all_to_all (single device, or outside shard_map)
     moe_ep_axis: Optional[str] = None
+    # mesh axes to pmean router statistics over (layout-exact global aux;
+    # config.router_aux_global) — None = per-device statistics
+    moe_stat_axes: Optional[tuple] = None
     # makes the MoE aux-loss scalar tp-INVARIANT under sequence parallelism
     # (every tp rank computes it from the same gathered tokens, but the
     # gather's output is typed tp-varying; a pmean re-establishes the
@@ -292,29 +302,39 @@ def _mlp_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx):
 
 def _moe_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx):
     """RMSNorm -> top-k routed expert SwiGLU bank (beyond the reference;
-    ops/moe.py). Returns (out, aux_loss)."""
+    ops/moe.py). Returns (out, aux [2])."""
     from picotron_tpu.ops.moe import moe_mlp
 
     h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
     h = ctx.f(h)
-    out, aux = moe_mlp(
+    out, aux, drop = moe_mlp(
         h, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
         num_experts=cfg.num_experts,
         top_k=cfg.num_experts_per_token,
         capacity_factor=cfg.capacity_factor,
         ep_axis=ctx.moe_ep_axis,
+        router_aux_coef=cfg.router_aux_coef,
+        router_z_coef=cfg.router_z_coef,
+        stat_axes=ctx.moe_stat_axes,
     )
-    return ctx.g(out), ctx.moe_aux_sync(aux)
+    # Zero-padded PP layer slots (pad_layers_for_pp) must not contribute
+    # router statistics: their all-zero router yields uniform logits whose
+    # z-loss (log(E)^2 per token) and tie-broken top-k capacity overflow
+    # would pollute the loss and the drop metric (code review r3). A real
+    # layer's random-init router is never exactly all-zero.
+    is_real = jnp.any(lp["router"] != 0).astype(jnp.float32)
+    return ctx.g(out), ctx.moe_aux_sync(jnp.stack([aux, drop]) * is_real)
 
 
 def decoder_layer(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
-    """Returns (x, aux_loss) — aux is 0 for dense models, the MoE
-    load-balancing term otherwise."""
+    """Returns (x, aux [2]) — aux[0] is the pre-weighted router loss
+    (balance + z, 0 for dense models), aux[1] the capacity drop fraction
+    (observability; stop_gradient-free but weightless in the loss)."""
     x = x + _attention_block(x, lp, cfg, ctx, cos, sin)
     if cfg.num_experts:
         mlp_out, aux = _moe_block(x, lp, cfg, ctx)
     else:
-        mlp_out, aux = _mlp_block(x, lp, cfg, ctx), jnp.zeros((), jnp.float32)
+        mlp_out, aux = _mlp_block(x, lp, cfg, ctx), jnp.zeros(2, jnp.float32)
     return x + mlp_out, aux
 
 
@@ -345,8 +365,9 @@ def run_layers(layer_params: Params, x: jnp.ndarray, cfg: ModelConfig,
     """Scan a stacked layer pytree over x. Works on any contiguous stage
     slice, which is exactly what pipeline parallelism feeds it.
 
-    Returns (x, aux_loss_sum) — aux is the summed MoE load-balancing loss
-    over the scanned layers (0 for dense models)."""
+    Returns (x, aux [2]) — aux[0] the summed pre-weighted MoE router loss
+    over the scanned layers, aux[1] the summed capacity drop fraction
+    (both 0 for dense models)."""
     if cos is None:
         cos, sin = rope_tables(cfg.max_position_embeddings, cfg.head_dim,
                                cfg.rope_theta)
@@ -359,8 +380,8 @@ def run_layers(layer_params: Params, x: jnp.ndarray, cfg: ModelConfig,
 
     if ctx.remat:
         body = jax.checkpoint(body, policy=remat_policy_for(ctx.remat_policy))
-    x, aux_per_layer = jax.lax.scan(body, x, layer_params)
-    return x, jnp.sum(aux_per_layer)
+    x, aux_per_layer = jax.lax.scan(body, x, layer_params)  # [L, 2]
+    return x, jnp.sum(aux_per_layer, axis=0)
 
 
 def final_hidden(params: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
@@ -402,11 +423,13 @@ def loss_sum_count(params: Params, input_ids: jnp.ndarray, targets: jnp.ndarray,
     Under TP, `ctx.head_ce` computes the pieces against vocab-sharded logits
     without materializing the full-vocab gather.
 
-    For MoE models the load-balancing aux loss is folded in as
-    `nll_sum + coef * aux * count`, so the downstream `total / count`
-    division yields `ce_mean + coef * aux` — the reported loss includes the
-    aux term (Mixtral convention) and its gradient flows with no extra
-    plumbing through the dp/cp/pp reductions.
+    For MoE models the (pre-weighted, ops/moe.py) router loss is folded in
+    as `nll_sum + aux * count`, so the downstream `total / count` division
+    yields `ce_mean + aux` — the reported loss includes the router terms
+    (Mixtral convention) and their gradient flows with no extra plumbing
+    through the dp/cp/pp reductions. The third return is an extras dict of
+    token-weighted observability sums ({"moe_drop_weighted"} for MoE, {}
+    for dense) that ride the same psum path; the step normalizes them.
     """
     cos, sin = rope_tables(cfg.max_position_embeddings, cfg.head_dim, cfg.rope_theta)
     x = embed(params, input_ids, cfg, ctx)
@@ -417,13 +440,15 @@ def loss_sum_count(params: Params, input_ids: jnp.ndarray, targets: jnp.ndarray,
     else:
         logits = x @ params["lm_head"].astype(x.dtype)
         total, count = cross_entropy_sum_count(logits, targets)
+    extras = {}
     if cfg.num_experts:
-        total = total + cfg.router_aux_coef * aux * count
-    return total, count
+        total = total + aux[0] * count
+        extras["moe_drop_weighted"] = aux[1] * count
+    return total, count, extras
 
 
 def loss_fn(params: Params, input_ids: jnp.ndarray, targets: jnp.ndarray,
             cfg: ModelConfig, ctx: ParallelCtx = DEFAULT_CTX) -> jnp.ndarray:
     """Token-mean cross-entropy training loss (ref: train.py:43-49)."""
-    total, count = loss_sum_count(params, input_ids, targets, cfg, ctx)
+    total, count, _ = loss_sum_count(params, input_ids, targets, cfg, ctx)
     return total / jnp.maximum(count, 1)

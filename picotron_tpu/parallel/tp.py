@@ -84,26 +84,65 @@ def vocab_parallel_ce_sum_count(hidden: jnp.ndarray, head_shard: jnp.ndarray,
     targets: [B, S] with IGNORE_INDEX allowed. Both outputs are replicated
     over tp. Matches ops.losses.cross_entropy_sum_count numerically.
     """
+    # One implementation, two entry points: this delegates to the
+    # local-stats/merge split the pipeline engines use, so the fused and
+    # gated scoring paths cannot numerically diverge (code review r3).
+    stats = vocab_parallel_ce_local_stats(hidden, head_shard, targets, axis)
+    total = vocab_parallel_ce_merge(stats, targets, axis)
+    return total, jnp.sum(targets != IGNORE_INDEX)
+
+
+def vocab_parallel_ce_local_stats(hidden: jnp.ndarray,
+                                  head_shard: jnp.ndarray,
+                                  targets: jnp.ndarray, axis: str = "tp"):
+    """The collective-free half of `vocab_parallel_ce_sum_count`: this
+    shard's softmax statistics, (local_max, local_sumexp, local_label), each
+    [B, S] fp32. Pair with `vocab_parallel_ce_merge` for the cross-shard
+    reduction.
+
+    The split exists for the pipeline engines: the expensive part (the
+    [B*S, H] x [H, V/tp] head matmul and the exp) runs inside a `lax.cond`
+    taken only by the last pp stage, which therefore must contain no
+    cross-device collectives — a collective whose replica group spans
+    devices that take different branches leaves the in-branch members
+    waiting on peers that never arrive (a rendezvous deadlock on the CPU
+    backend; here the risk is the pvary-transpose psums over 'pp' that
+    implicit varying-type promotion would insert into the backward cond).
+    The [B, S]-sized pmax/psum merge runs unconditionally on every stage —
+    three tiny uniform collectives per tick.
+    """
     logits = (hidden @ head_shard.astype(hidden.dtype)).astype(jnp.float32)
     vshard = logits.shape[-1]
     lo = lax.axis_index(axis) * vshard
-
-    # logsumexp over the full (sharded) vocab: pmax for the max, psum for the
-    # sum of exponentials. stop_gradient on the max (standard softmax trick —
-    # the max's gradient contribution cancels exactly).
-    m = lax.pmax(jax.lax.stop_gradient(jnp.max(logits, axis=-1)), axis)  # [B,S]
-    sumexp = lax.psum(jnp.sum(jnp.exp(logits - m[..., None]), axis=-1), axis)
-    logz = m + jnp.log(sumexp)  # [B, S]
-
+    m_loc = jax.lax.stop_gradient(jnp.max(logits, axis=-1))  # [B, S]
+    sumexp_loc = jnp.sum(jnp.exp(logits - m_loc[..., None]), axis=-1)
     valid = targets != IGNORE_INDEX
     rel = jnp.where(valid, targets, 0) - lo
     ok = (rel >= 0) & (rel < vshard)
     relc = jnp.clip(rel, 0, vshard - 1)
-    local_label = jnp.take_along_axis(logits, relc[..., None], axis=-1).squeeze(-1)
-    label_logit = lax.psum(local_label * ok.astype(jnp.float32), axis)
+    label_loc = (jnp.take_along_axis(logits, relc[..., None], axis=-1)
+                 .squeeze(-1) * ok.astype(jnp.float32))
+    return m_loc, sumexp_loc, label_loc
 
-    nll = jnp.where(valid, logz - label_logit, 0.0)
-    return jnp.sum(nll), jnp.sum(valid)
+
+def vocab_parallel_ce_merge(stats, targets: jnp.ndarray, axis: str = "tp"):
+    """Cross-shard merge of `vocab_parallel_ce_local_stats` -> NLL sum.
+    Numerically identical to `vocab_parallel_ce_sum_count`'s fused path:
+    psum_r[exp(m_r - m) * sum_v exp(l_rv - m_r)] == psum over the full
+    vocab of exp(l - m)."""
+    m_loc, sumexp_loc, label_loc = stats
+    # m is a pure shift constant (its gradient contribution cancels exactly
+    # — the standard logsumexp trick); stop_gradient here also covers the
+    # pipeline's cond-anchored neutral stats, whose m_loc arrives with a
+    # (zero-valued but non-symbolic) tangent that pmax cannot differentiate.
+    m_loc = jax.lax.stop_gradient(m_loc)
+    m = lax.pmax(m_loc, axis)
+    sumexp = lax.psum(sumexp_loc * jnp.exp(m_loc - m), axis)
+    logz = m + jnp.log(sumexp)
+    label = lax.psum(label_loc, axis)
+    valid = targets != IGNORE_INDEX
+    nll = jnp.where(valid, logz - label, 0.0)
+    return jnp.sum(nll)
 
 
 def vocab_parallel_ce(hidden: jnp.ndarray, head_shard: jnp.ndarray,
