@@ -82,8 +82,8 @@ def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
              jax.device_put(toks[..., 1:], sharding))
 
     for _ in range(max(warmup, 1)):  # >=1 so compile stays out of the timing
-        state, loss = step(state, batch)
-    float(loss)  # value fetch: cannot return before the warmup chain ran
+        state, metrics = step(state, batch)
+    float(metrics["loss"])  # value fetch: cannot return before the warmup chain ran
 
     # Time N chained steps, fetching ONLY the final loss. The data dependency
     # (loss_N needs state_{N-1} needs ... state_0) forces every step to have
@@ -96,8 +96,8 @@ def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
         jax.profiler.start_trace(profile)
     t0 = time.perf_counter()
     for _ in range(steps):
-        state, loss = step(state, batch)
-    final_loss = float(loss)
+        state, metrics = step(state, batch)
+    final_loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
     if profile:
         jax.profiler.stop_trace()

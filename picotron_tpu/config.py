@@ -216,6 +216,14 @@ class ModelConfig:
     moe_intermediate_size: Optional[int] = None  # default: intermediate_size
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
+    # Router z-loss coefficient (ST-MoE eq. 5; 1e-3 there). 0 disables.
+    router_z_coef: float = 0.0
+    # Compute router aux statistics (balance f/P, z-loss mean) over the
+    # GLOBAL batch via pmean over the data axes — layout-exact losses
+    # (identical for any dp/cp/ep factorization). False = per-device
+    # statistics (cheaper by two [E]-sized pmeans per layer, differs across
+    # layouts by O(shard variance)).
+    router_aux_global: bool = True
     # Accepted for reference compat (ref uses them to pick CUDA kernels).
     use_flash_attention: bool = True
     use_fused_adam: bool = True
@@ -291,6 +299,11 @@ class CheckpointConfig:
 
     save_dir: str = "ckpt"
     save_frequency: int = 0  # 0 disables periodic saving
+    # Async Orbax save (SURVEY §5): save() stages device->host copies and
+    # returns; the disk write overlaps subsequent training steps. The
+    # trainer waits for durability at exit. False = blocking saves (the
+    # reference's behavior, ref: checkpoint.py:246-260).
+    async_save: bool = True
     load_path: str = ""
     # Optional HF safetensors dir to materialize initial weights from (the
     # reference's bootstrap reads safetensors but only as shape templates,

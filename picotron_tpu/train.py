@@ -157,17 +157,19 @@ def main(argv=None) -> None:
             jax.profiler.start_trace(prof.profile_dir)
             tracing = True
         batch = next(dl)
-        state, loss = step_fn(state, batch)
+        state, metrics = step_fn(state, batch)
         trained_tokens += cfg.tokens_per_step
         if (tracing and step - start_step
                 >= prof.profile_start_step + prof.profile_num_steps - 1):
-            jax.block_until_ready(loss)
+            jax.block_until_ready(metrics)
             jax.profiler.stop_trace()
             tracing = False
             log_print(f"profiler trace -> {prof.profile_dir}")
 
         if step % cfg.logging.log_frequency == 0 or step == total_steps:
-            loss = float(jax.block_until_ready(loss))
+            metrics = {k: float(v)
+                       for k, v in jax.block_until_ready(metrics).items()}
+            loss = metrics.pop("loss")
             dt = timer.lap()
             steps_in_window = step - last_logged_step
             last_logged_step = step
@@ -176,12 +178,13 @@ def main(argv=None) -> None:
                            n_chips, peak)
             line = training_log_line(
                 step, loss, tokens_per_sec, tokens_per_sec / n_chips,
-                mfu_frac, trained_tokens, device_memory_gb())
+                mfu_frac, trained_tokens, device_memory_gb(), extras=metrics)
             log_print(line)
             if wandb_run is not None:
                 wandb_run.log({"loss": loss, "tokens_per_sec": tokens_per_sec,
                                "mfu": mfu_frac,
-                               "trained_tokens": trained_tokens}, step=step)
+                               "trained_tokens": trained_tokens, **metrics},
+                              step=step)
 
         if ckpt_mgr is not None and step % cfg.checkpoint.save_frequency == 0:
             path = ckpt_mgr.save(state, trained_tokens,
@@ -200,6 +203,10 @@ def main(argv=None) -> None:
     # earlier run into the same save_dir cannot suppress the save.
     if ckpt_mgr is not None and int(state.step) not in saved_steps:
         ckpt_mgr.save(state, trained_tokens, dataloader_state=dl.state)
+    if ckpt_mgr is not None:
+        # Async saves overlap training; the process must not exit before
+        # the last one is durable.
+        ckpt_mgr.wait_until_finished()
     dl.close()
     if wandb_run is not None:
         wandb_run.finish()

@@ -40,7 +40,8 @@ def accumulate_grads(params, batch, cfg: Config, ctx: ParallelCtx):
     batch: (input_ids, targets), each [n_micro, mbs, seq].
     """
     def nll(params, ids, tgt):
-        return loss_sum_count(params, ids, tgt, cfg.model, ctx)
+        total, count, _ = loss_sum_count(params, ids, tgt, cfg.model, ctx)
+        return total, count
 
     def micro_step(carry, mb):
         grads_acc, loss_acc, count_acc = carry

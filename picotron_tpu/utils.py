@@ -128,11 +128,14 @@ class StepTimer:
 
 def training_log_line(step: int, loss: float, tokens_per_sec: float,
                       tokens_per_sec_per_chip: float, mfu_frac: float,
-                      trained_tokens: int, memory_gb: float = 0.0) -> str:
+                      trained_tokens: int, memory_gb: float = 0.0,
+                      extras: Optional[dict] = None) -> str:
     """The per-step console line. Format is a de-facto API consumed by the
     metrics harvester (ref: train.py:248-259 <-> extract_metrics.py:55-68);
-    tools/extract_metrics.py parses exactly these field names."""
-    return (
+    tools/extract_metrics.py parses exactly these field names. `extras`
+    appends step-metric scalars after the stable fields (e.g. MoE's
+    `moe_drop_frac`), so the harvester's prefix parse is unaffected."""
+    line = (
         f"[step {step:06d}] loss: {loss:.4f} | "
         f"tokens/s: {human_format(tokens_per_sec)} | "
         f"tokens/s/chip: {human_format(tokens_per_sec_per_chip)} | "
@@ -140,15 +143,25 @@ def training_log_line(step: int, loss: float, tokens_per_sec: float,
         f"tokens: {human_format(trained_tokens)} | "
         f"mem: {memory_gb:.1f}GB"
     )
+    for k, v in (extras or {}).items():
+        line += f" | {k}: {v:.4f}"
+    return line
 
 
 def device_memory_gb() -> float:
     """Peak on-device memory in GiB if the backend exposes it (the TPU
-    analogue of torch.cuda.memory_reserved, ref: train.py:255)."""
-    try:
-        stats = jax.local_devices()[0].memory_stats()
-        if stats and "peak_bytes_in_use" in stats:
-            return stats["peak_bytes_in_use"] / (1024 ** 3)
-    except Exception:
-        pass
-    return 0.0
+    analogue of torch.cuda.memory_reserved, ref: train.py:255). Max over
+    this process's local devices — under tp/pp sharding different chips
+    peak differently, and the max is the one that OOMs. (Cross-host maxing
+    would need a collective; each host logging its own max is the useful
+    view since log_print gates to process 0, whose chips are
+    representative under SPMD.)"""
+    peak = 0.0
+    for dev in jax.local_devices():
+        try:
+            stats = dev.memory_stats()
+            if stats and "peak_bytes_in_use" in stats:
+                peak = max(peak, stats["peak_bytes_in_use"] / (1024 ** 3))
+        except Exception:
+            pass
+    return peak

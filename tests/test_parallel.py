@@ -64,8 +64,8 @@ def run_parallel(cfg, steps=3):
     batch = (jax.device_put(ids, sh), jax.device_put(tgt, sh))
     losses = []
     for _ in range(steps):
-        state, loss = step(state, batch)
-        losses.append(float(loss))
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
     return losses, state
 
 
