@@ -50,6 +50,7 @@ def test_save_restore_roundtrip(tmp_path):
     mgr = CheckpointManager(cfg, menv)
     mgr.save(state, trained_tokens=1234,
              dataloader_state={"epoch": 2, "cursor": 6})
+    mgr.wait_until_finished()  # async save: durable only after the barrier
     assert mgr.latest_step() == 1
 
     template = init_sharded_state(cfg, menv, jax.random.key(99))
@@ -69,6 +70,55 @@ def test_save_restore_roundtrip(tmp_path):
     # the restored state must be directly trainable (placement-consistent)
     stepped, _ = step(restored, batch_for(cfg, menv))
     assert int(stepped.step) == 2
+
+
+def test_async_save_overlaps_training_and_survives_donation(tmp_path):
+    """Async save must capture the state at save() time: the trainer keeps
+    stepping (with donated buffers!) while the write is in flight, so a
+    save that lazily read device memory would persist garbage. Also pins
+    that resume-from-an-async-save works and that wait_until_finished
+    makes it durable (VERDICT r2 next-round #6)."""
+    cfg = make_cfg(tmp_path, dp_size=2, tp_size=2)
+    assert cfg.checkpoint.async_save  # the default
+    menv = MeshEnv.from_config(cfg)
+    state = init_sharded_state(cfg, menv, jax.random.key(0))
+    step = make_train_step(cfg, menv)
+    batch = batch_for(cfg, menv)
+    state, _ = step(state, batch)
+    saved_embedding = np.asarray(state.params["embedding"]).copy()
+
+    mgr = CheckpointManager(cfg, menv)
+    mgr.save(state, trained_tokens=99)
+    # training continues while the write is (possibly still) in flight;
+    # donation invalidates the old state buffers
+    for _ in range(3):
+        state, _ = step(state, batch)
+    assert int(state.step) == 4
+    mgr.wait_until_finished()
+    assert mgr.latest_step() == 1
+
+    template = init_sharded_state(cfg, menv, jax.random.key(99))
+    restored, meta = mgr.restore(template)
+    assert int(restored.step) == 1 and meta["trained_tokens"] == 99
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["embedding"]), saved_embedding)
+
+
+def test_latest_step_skips_unfinalized_checkpoints(tmp_path):
+    """A crashed/in-flight async save leaves a step dir without a finalized
+    `state` directory — restore must never be pointed at it."""
+    cfg = make_cfg(tmp_path, dp_size=2)
+    menv = MeshEnv.from_config(cfg)
+    state = init_sharded_state(cfg, menv, jax.random.key(0))
+    mgr = CheckpointManager(cfg, menv)
+    mgr.save(state)
+    mgr.wait_until_finished()
+    assert mgr.latest_step() == 0
+    # simulate a torn step_5 save: meta.json present, state dir absent
+    torn = tmp_path / "ckpt" / "step_00000005"
+    torn.mkdir(parents=True)
+    (torn / "meta.json").write_text("{}")
+    assert mgr.latest_step() == 0
 
 
 def test_restore_across_topologies(tmp_path):
