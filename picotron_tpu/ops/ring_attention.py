@@ -29,12 +29,18 @@ Design differences from the reference, all deliberate:
   (ref: data.py:105-109), whose known causal load imbalance
   (SURVEY.md §3.4) is inherent to the layout, not to this kernel.
 
-Full-compute note: every device computes every visiting block, with fully
-masked (future) blocks contributing zero via lse = -inf. The reference skips
-those blocks per-rank (`step <= rank`, ref: context_parallel.py:36), but under
-SPMD a data-dependent skip would still execute as a select on TPU; the real
-fix for the causal imbalance is zigzag ordering, which changes `positions`,
-not this function.
+Block-skip note: a visiting block that is entirely in the causal future
+(min kv position > max q position) skips the whole blockwise kernel via
+`lax.cond` — the per-rank skip the reference does with Python control flow
+(`step <= rank`, ref: context_parallel.py:36). The branch is exact: a fully
+masked block would have contributed (out=0, lse=-inf), which is precisely
+what the skip branch returns, so layouts are bit-compatible with
+full compute. Under the default zigzag layout every block pair is partially
+visible and the branch never fires (work is balanced by construction);
+under `cp_layout: "contiguous"` rank r skips cp-1-r of its cp visiting
+blocks, halving the layout's average wasted FLOPs. The branch body is
+collective-free (a pure kernel call), which keeps the divergent cond
+SPMD-sound — see parallel/pp.py's branch rules.
 """
 
 from __future__ import annotations
@@ -119,15 +125,34 @@ def ring_attention(
     fwd_perm = [(i, (i + 1) % n) for i in range(n)]
     kv_positions = q_positions
 
+    q_max = jnp.max(q_positions)
+
     for step in range(n):
-        out_blk, lse_blk = attn_block(
-            q, k, v,
-            causal=True,
-            q_positions=q_positions,
-            kv_positions=kv_positions,
-        )
-        out_acc, lse_acc = _merge(out_acc, lse_acc,
-                                  out_blk.astype(jnp.float32), lse_blk)
+        # Whole-block causal skip: blocks entirely in the future contribute
+        # exactly (out=0, lse=-inf). The skip branch anchors its constants
+        # on zero-weighted elements of the compute branch's operands so the
+        # branches agree on varying type without pcast (whose transpose
+        # would put a psum inside the divergent backward branch — the
+        # rendezvous-deadlock hazard documented in parallel/pp.py).
+        kv_pos = kv_positions
+
+        def compute(opnds, kv_pos=kv_pos):
+            q_, k_, v_ = opnds
+            ob, lb = attn_block(q_, k_, v_, causal=True,
+                                q_positions=q_positions,
+                                kv_positions=kv_pos)
+            return ob.astype(jnp.float32), lb.astype(jnp.float32)
+
+        def skip(opnds):
+            q_, k_, v_ = opnds
+            a = (q_.ravel()[0] + k_.ravel()[0]
+                 + v_.ravel()[0]).astype(jnp.float32) * 0.0
+            return (jnp.zeros((b, s_local, h, d), jnp.float32) + a,
+                    jnp.full((b, h, s_local), -jnp.inf, jnp.float32) + a)
+
+        fully_masked = jnp.min(kv_pos) > q_max
+        out_blk, lse_blk = lax.cond(fully_masked, skip, compute, (q, k, v))
+        out_acc, lse_acc = _merge(out_acc, lse_acc, out_blk, lse_blk)
         if step != n - 1:
             k = lax.ppermute(k, axis, fwd_perm)
             v = lax.ppermute(v, axis, fwd_perm)

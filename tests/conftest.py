@@ -11,17 +11,55 @@ bench.py touches the real chip.
 """
 
 import os
+import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+
+def _tpu_marker_requested(argv) -> bool:
+    """`pytest -m tpu` selects the on-hardware tests (tests/test_tpu_hw.py)
+    — those need the REAL chip, so the CPU forcing below must not run."""
+    for i, a in enumerate(argv):
+        expr = None
+        if a == "-m" and i + 1 < len(argv):
+            expr = argv[i + 1]
+        elif a.startswith("-m=") or a.startswith("--markexpr="):
+            expr = a.split("=", 1)[1]
+        if expr and "tpu" in expr and "not tpu" not in expr:
+            return True
+    return False
+
+
+ON_HARDWARE = _tpu_marker_requested(sys.argv)
+
+if not ON_HARDWARE:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not ON_HARDWARE:
+    jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: on-hardware kernel regression tests (run `pytest -m tpu` on "
+        "a machine with a real TPU; skipped/deselected otherwise)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if ON_HARDWARE:
+        return
+    skip = pytest.mark.skip(
+        reason="needs a real TPU; run `pytest -m tpu` on the bench chip")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip)
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,128 @@
+"""On-hardware kernel regression tests — `pytest -m tpu` on the bench chip.
+
+The regular suite exercises the Pallas kernels in interpreter mode on the
+simulated CPU mesh; these run the COMPILED kernels on the real TPU and gate
+them against the jnp reference (the pytest version of tools/flash_smoke.py —
+VERDICT r2 next-round #8: hardware kernel correctness as a one-command check
+instead of a manual script). Tolerances are bf16-level: blockwise-vs-fused
+softmax reassociation puts maxdiffs in the 0.01-0.25 band on real data.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+_on_tpu = jax.devices()[0].platform == "tpu"
+if _on_tpu:  # imports are safe either way; guard only the device check
+    pass
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    if not _on_tpu:
+        pytest.skip("no TPU attached")
+    ks = jax.random.split(jax.random.key(0), 3)
+    b, s, hq, hkv, d = 2, 2048, 16, 4, 64
+    return (jax.random.normal(ks[0], (b, s, hq, d), jnp.bfloat16),
+            jax.random.normal(ks[1], (b, s, hkv, d), jnp.bfloat16),
+            jax.random.normal(ks[2], (b, s, hkv, d), jnp.bfloat16))
+
+
+def _maxdiff(a, b):
+    return float(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)).max())
+
+
+def test_flash_forward_matches_sdpa_on_chip(qkv):
+    from picotron_tpu.ops.attention import sdpa_attention
+    from picotron_tpu.ops.flash_attention import flash_attention
+
+    q, k, v = qkv
+    got = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, interpret=False))(q, k, v)
+    want = jax.jit(lambda q, k, v: sdpa_attention(q, k, v, causal=True))(
+        q, k, v)
+    assert _maxdiff(got, want) < 0.05
+
+
+def test_flash_backward_matches_sdpa_on_chip(qkv):
+    from picotron_tpu.ops.attention import sdpa_attention
+    from picotron_tpu.ops.flash_attention import flash_attention
+
+    q, k, v = qkv
+
+    def floss(q, k, v):
+        return jnp.sum(flash_attention(
+            q, k, v, causal=True, interpret=False).astype(jnp.float32) ** 2)
+
+    def rloss(q, k, v):
+        return jnp.sum(
+            sdpa_attention(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    got = jax.jit(jax.grad(floss, (0, 1, 2)))(q, k, v)
+    want = jax.jit(jax.grad(rloss, (0, 1, 2)))(q, k, v)
+    for x, y, n in zip(got, want, "qkv"):
+        # grads of a sum-of-squares over 2048 tokens: bf16 accumulation
+        # reassociation puts the band well above fwd's
+        assert _maxdiff(x, y) < 0.5, f"d{n}"
+
+
+def test_flash_fused_rope_matches_unfused_on_chip(qkv):
+    from picotron_tpu.ops.flash_attention import flash_attention
+    from picotron_tpu.ops.rope import apply_rope, rope_tables
+
+    q, k, v = qkv
+    s, d = q.shape[1], q.shape[3]
+    cos, sin = rope_tables(s, d)
+
+    def fused(q, k, v):
+        return flash_attention(q, k, v, causal=True, rope=(cos, sin),
+                               interpret=False).astype(jnp.float32)
+
+    def unfused(q, k, v):
+        return flash_attention(apply_rope(q, cos, sin),
+                               apply_rope(k, cos, sin), v, causal=True,
+                               interpret=False).astype(jnp.float32)
+
+    got = jax.jit(fused)(q, k, v)
+    want = jax.jit(unfused)(q, k, v)
+    assert _maxdiff(got, want) < 0.05
+    gf = jax.jit(jax.grad(lambda *a: jnp.sum(fused(*a) ** 2), (0, 1, 2)))
+    gu = jax.jit(jax.grad(lambda *a: jnp.sum(unfused(*a) ** 2), (0, 1, 2)))
+    for x, y, n in zip(gf(q, k, v), gu(q, k, v), "qkv"):
+        assert _maxdiff(x, y) < 0.5, f"d{n}"
+
+
+def test_train_step_runs_on_chip():
+    """One real bf16 train step of a depth-reduced SmolLM on the chip —
+    the bench path's compile+execute sanity, minus the timing."""
+    if not _on_tpu:
+        pytest.skip("no TPU attached")
+    from picotron_tpu.config import (
+        Config, DistributedConfig, ModelConfig, TrainingConfig, resolve_preset,
+    )
+    from picotron_tpu.mesh import MeshEnv
+    from picotron_tpu.parallel.api import init_sharded_state, make_train_step
+
+    preset = resolve_preset("SmolLM-360M")
+    preset["num_hidden_layers"] = 4
+    cfg = Config(
+        distributed=DistributedConfig(dp_size=1),
+        model=ModelConfig(name="SmolLM-360M", **preset),
+        training=TrainingConfig(seq_length=512, micro_batch_size=1,
+                                gradient_accumulation_steps=1, remat=True),
+    )
+    cfg.validate()
+    menv = MeshEnv.create(dp=1, devices=jax.devices()[:1])
+    state = init_sharded_state(cfg, menv, jax.random.key(0))
+    step = make_train_step(cfg, menv)
+    toks = jax.random.randint(jax.random.key(1), (1, 1, 513), 0,
+                              cfg.model.vocab_size)
+    sh = menv.batch_sharding()
+    batch = (jax.device_put(toks[..., :-1], sh),
+             jax.device_put(toks[..., 1:], sh))
+    state, metrics = step(state, batch)
+    loss = float(metrics["loss"])
+    assert np.isfinite(loss) and 2.0 < loss < 20.0, loss
