@@ -39,7 +39,7 @@ SWEEP = [
 def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
             steps: int = 8, warmup: int = 2, remat: bool = True,
             remat_policy: str = "dots", adam_moments_dtype: str = "bfloat16",
-            profile: str | None = None) -> dict:
+            ce_chunk: int = 0, profile: str | None = None) -> dict:
     from picotron_tpu.config import (
         Config, DistributedConfig, ModelConfig, TrainingConfig, resolve_preset,
     )
@@ -64,6 +64,7 @@ def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
             remat=remat,
             remat_policy=remat_policy,
             adam_moments_dtype=adam_moments_dtype,
+            ce_chunk_size=ce_chunk,
         ),
     )
     cfg.validate()
@@ -143,6 +144,12 @@ def main() -> None:
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--no-remat", action="store_true")
     ap.add_argument("--remat-policy", default="dots", choices=["full", "dots"])
+    ap.add_argument("--ce-chunk", type=int, default=0,
+                    help="stream the LM-head CE over vocab chunks of this "
+                         "size (0 = fused): ~tokens*vocab*2B less peak HBM "
+                         "for one extra chunk matmul in backward — a "
+                         "memory knob for big-vocab models (Llama-3 128k); "
+                         "costs ~5%% MFU at SmolLM shapes (PERF.md)")
     ap.add_argument("--adam-moments-dtype", default="bfloat16",
                     choices=["float32", "bfloat16"],
                     help="bf16 moments halve optimizer-state HBM traffic "
@@ -173,6 +180,7 @@ def main() -> None:
                     "seq": (2048, "--seq"), "mbs": (5, "--mbs"),
                     "grad_acc": (1, "--grad-acc"),
                     "layers": (None, "--layers"),
+                    "ce_chunk": (0, "--ce-chunk"),
                     "profile": (None, "--profile"),
                     "no_remat": (False, "--no-remat")}
         clashing = [flag for k, (v, flag) in defaults.items()
@@ -200,7 +208,8 @@ def main() -> None:
         args.model, args.layers, args.seq, args.mbs, grad_acc=args.grad_acc,
         steps=args.steps, warmup=args.warmup, remat=not args.no_remat,
         remat_policy=args.remat_policy,
-        adam_moments_dtype=args.adam_moments_dtype, profile=args.profile)))
+        adam_moments_dtype=args.adam_moments_dtype, ce_chunk=args.ce_chunk,
+        profile=args.profile)))
 
 
 if __name__ == "__main__":

@@ -271,6 +271,13 @@ class TrainingConfig:
     gradient_accumulation_steps: int = 1
     num_samples: Optional[int] = None
     max_tokens: Optional[int] = None
+    # Stream the LM-head cross-entropy over vocab chunks of this many
+    # columns: the [tokens, vocab] logits never materialize (neither as a
+    # forward tensor nor a saved backward residual — chunks recompute),
+    # trading one extra chunk matmul in backward for ~tokens*vocab*2 bytes
+    # of peak HBM. 0 disables (fused single-matmul CE). Must divide
+    # vocab_size / tp_size or it silently falls back to fused.
+    ce_chunk_size: int = 0
     # Gradient rematerialization for long-context / big-model memory savings.
     remat: bool = True
     # "full" recomputes everything in backward (max memory savings);

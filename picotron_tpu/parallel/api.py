@@ -141,14 +141,16 @@ def make_parallel_ctx(cfg: Config) -> ParallelCtx:
             return attn_fn(q, k, v, causal=True,
                            q_positions=pos, kv_positions=pos)
 
-    ce = partial(vocab_parallel_ce_sum_count, axis="tp")
+    ce_chunk = cfg.training.ce_chunk_size
+    ce = partial(vocab_parallel_ce_sum_count, axis="tp", chunk_size=ce_chunk)
     hooks = dict(
         g=lambda x: lax.psum(x, "tp"),
         embed_lookup=partial(vocab_parallel_embed, axis="tp"),
         head_ce=ce,
         # the split form lets the PP engines run the head matmul only on
         # the last stage (collective-free branch + tiny uniform merge)
-        head_ce_local=partial(vocab_parallel_ce_local_stats, axis="tp"),
+        head_ce_local=partial(vocab_parallel_ce_local_stats, axis="tp",
+                              chunk_size=ce_chunk),
         head_ce_merge=partial(vocab_parallel_ce_merge, axis="tp"),
     )
     if d.sequence_parallel:

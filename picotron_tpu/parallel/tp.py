@@ -76,7 +76,8 @@ def sp_scatter_seq(x: jnp.ndarray, axis: str = "tp") -> jnp.ndarray:
 
 
 def vocab_parallel_ce_sum_count(hidden: jnp.ndarray, head_shard: jnp.ndarray,
-                                targets: jnp.ndarray, axis: str = "tp"):
+                                targets: jnp.ndarray, axis: str = "tp",
+                                chunk_size: int = 0):
     """(sum of per-token NLL, valid-token count) against a vocab-sharded LM
     head — the reduction pieces, so dp/cp shards can psum both and divide once.
 
@@ -87,14 +88,16 @@ def vocab_parallel_ce_sum_count(hidden: jnp.ndarray, head_shard: jnp.ndarray,
     # One implementation, two entry points: this delegates to the
     # local-stats/merge split the pipeline engines use, so the fused and
     # gated scoring paths cannot numerically diverge (code review r3).
-    stats = vocab_parallel_ce_local_stats(hidden, head_shard, targets, axis)
+    stats = vocab_parallel_ce_local_stats(hidden, head_shard, targets, axis,
+                                          chunk_size=chunk_size)
     total = vocab_parallel_ce_merge(stats, targets, axis)
     return total, jnp.sum(targets != IGNORE_INDEX)
 
 
 def vocab_parallel_ce_local_stats(hidden: jnp.ndarray,
                                   head_shard: jnp.ndarray,
-                                  targets: jnp.ndarray, axis: str = "tp"):
+                                  targets: jnp.ndarray, axis: str = "tp",
+                                  chunk_size: int = 0):
     """The collective-free half of `vocab_parallel_ce_sum_count`: this
     shard's softmax statistics, (local_max, local_sumexp, local_label), each
     [B, S] fp32. Pair with `vocab_parallel_ce_merge` for the cross-shard
@@ -111,17 +114,71 @@ def vocab_parallel_ce_local_stats(hidden: jnp.ndarray,
     The [B, S]-sized pmax/psum merge runs unconditionally on every stage —
     three tiny uniform collectives per tick.
     """
-    logits = (hidden @ head_shard.astype(hidden.dtype)).astype(jnp.float32)
-    vshard = logits.shape[-1]
+    vshard = head_shard.shape[-1]
     lo = lax.axis_index(axis) * vshard
-    m_loc = jax.lax.stop_gradient(jnp.max(logits, axis=-1))  # [B, S]
-    sumexp_loc = jnp.sum(jnp.exp(logits - m_loc[..., None]), axis=-1)
     valid = targets != IGNORE_INDEX
     rel = jnp.where(valid, targets, 0) - lo
+
+    if chunk_size and chunk_size < vshard and vshard % chunk_size == 0:
+        return _chunked_local_stats(hidden, head_shard, rel, chunk_size)
+
+    logits = (hidden @ head_shard.astype(hidden.dtype)).astype(jnp.float32)
+    m_loc = jax.lax.stop_gradient(jnp.max(logits, axis=-1))  # [B, S]
+    sumexp_loc = jnp.sum(jnp.exp(logits - m_loc[..., None]), axis=-1)
     ok = (rel >= 0) & (rel < vshard)
     relc = jnp.clip(rel, 0, vshard - 1)
     label_loc = (jnp.take_along_axis(logits, relc[..., None], axis=-1)
                  .squeeze(-1) * ok.astype(jnp.float32))
+    return m_loc, sumexp_loc, label_loc
+
+
+def _chunked_local_stats(hidden, head_shard, rel, chunk_size: int):
+    """Streaming form of the local CE stats: scan vocab chunks, keeping a
+    running (max, sumexp, label) merge, so the [N, V_local] logits tensor
+    never materializes — neither in forward nor as a saved residual (the
+    chunk body is jax.checkpoint'd, so backward recomputes each chunk's
+    logits from hidden/head instead of loading ~N*V saved values). At
+    SmolLM shapes ([10240, 49152] fp32 stats path) that trades one extra
+    chunk matmul in backward for ~1 GB of saved-residual HBM — the memory
+    that caps the micro-batch size (see PERF.md). Numerics match the fused
+    path: the running max-merge is the same logsumexp shift, stop_gradient
+    on every max."""
+    vshard = head_shard.shape[-1]
+    b_shape = rel.shape
+
+    def body(carry, off):
+        m_acc, se_acc, lab_acc = carry
+        wc = lax.dynamic_slice_in_dim(head_shard, off, chunk_size, axis=1)
+        logits = (hidden @ wc.astype(hidden.dtype)).astype(jnp.float32)
+        m_c = jax.lax.stop_gradient(jnp.max(logits, axis=-1))
+        m_new = jnp.maximum(m_acc, m_c)
+        se = (se_acc * jnp.exp(m_acc - m_new)
+              + jnp.sum(jnp.exp(logits - m_new[..., None]), axis=-1))
+        rc = rel - off
+        ok = (rc >= 0) & (rc < chunk_size)
+        rcc = jnp.clip(rc, 0, chunk_size - 1)
+        lab = (jnp.take_along_axis(logits, rcc[..., None], axis=-1)
+               .squeeze(-1) * ok.astype(jnp.float32))
+        return (m_new, se, lab_acc + lab), None
+
+    # The scan carry must already hold the varying type the body produces
+    # (tp via head/rel, data axes via hidden). Anchored with zero-weighted
+    # operand elements, NOT lax.pcast: this function also runs inside the
+    # pipeline's last-stage scoring cond, where a pcast's transpose would
+    # put a psum inside the divergent backward branch (parallel/pp.py's
+    # branch rules).
+    anchor = (hidden.ravel()[0].astype(jnp.float32)
+              + head_shard.ravel()[0].astype(jnp.float32)
+              + rel.ravel()[0].astype(jnp.float32)) * 0.0
+    init = (jnp.full(b_shape, -jnp.inf, jnp.float32) + anchor,
+            jnp.zeros(b_shape, jnp.float32) + anchor,
+            jnp.zeros(b_shape, jnp.float32) + anchor)
+    # exp(m_acc - m_new) with m_acc = -inf on the first chunk: m_new = m_c
+    # is finite (real logits), so the factor is exp(-inf) = 0, scaling the
+    # zero se_acc — no nan path.
+    offsets = jnp.arange(0, vshard, chunk_size)
+    (m_loc, sumexp_loc, label_loc), _ = lax.scan(
+        jax.checkpoint(body), init, offsets)
     return m_loc, sumexp_loc, label_loc
 
 

@@ -249,3 +249,25 @@ def test_zero1_moments_sharded_and_parity():
                 for a in (part if isinstance(part, (tuple, list)) else (part,))]
 
     assert all("dp" in flat_axes(s) for s in moment_specs), moment_specs
+
+
+def test_ce_chunking_matches_fused_across_layouts():
+    """ce_chunk_size streams the LM-head CE over vocab chunks without
+    materializing [tokens, vocab] logits; it must match the fused path to
+    fp precision, including through the pipeline engines' gated last-stage
+    scoring cond (whose branches must stay collective-free — the chunk
+    scan's carry anchoring is the load-bearing detail)."""
+    import dataclasses
+
+    base = tiny_cfg(pp_size=2, tp_size=2)
+    losses = {}
+    for chunk in (0, 16):
+        cfg = Config(
+            distributed=base.distributed,
+            model=base.model,
+            training=dataclasses.replace(base.training,
+                                         ce_chunk_size=chunk),
+        )
+        cfg.validate()
+        losses[chunk], _ = run_parallel(cfg)
+    np.testing.assert_allclose(losses[0], losses[16], rtol=1e-6, atol=1e-7)
