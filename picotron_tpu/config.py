@@ -257,6 +257,14 @@ class TrainingConfig:
 
     seed: int = 42
     learning_rate: float = 3e-4
+    # LR schedule: "constant" (the reference's behavior, ref: train.py:209
+    # builds a bare AdamW), "cosine" (linear warmup -> cosine decay to
+    # lr * lr_min_ratio over total_train_steps), or "linear" (warmup ->
+    # linear decay). Warmup counts from step 0 even on resume — the
+    # schedule reads the restored optimizer step count.
+    lr_schedule: str = "constant"
+    lr_warmup_steps: int = 0
+    lr_min_ratio: float = 0.1
     weight_decay: float = 0.0
     adam_beta1: float = 0.9
     adam_beta2: float = 0.999
@@ -312,6 +320,15 @@ class CheckpointConfig:
     # reference's behavior, ref: checkpoint.py:246-260).
     async_save: bool = True
     load_path: str = ""
+    # Resume from the newest durable checkpoint in save_dir when no
+    # load_path is given (no-op when save_dir holds none). This is the
+    # in-process half of preemption recovery: the reference's scheduler
+    # resubmits failed jobs (ref: submit_slurm_jobs.py:157-172) but each
+    # resubmission restarts from scratch unless resume is hand-configured;
+    # with auto_resume a resubmitted/preempted job continues where its
+    # last completed save left off — the standard arrangement for
+    # preemptible TPU pods.
+    auto_resume: bool = False
     # Optional HF safetensors dir to materialize initial weights from (the
     # reference's bootstrap reads safetensors but only as shape templates,
     # ref: checkpoint.py:93-101; we actually load the values).
@@ -401,6 +418,30 @@ class Config:
             raise ValueError(
                 f"adam_moments_dtype must be 'float32' or 'bfloat16', got "
                 f"{t.adam_moments_dtype!r}")
+        if t.lr_schedule not in ("constant", "cosine", "linear"):
+            raise ValueError(
+                f"lr_schedule must be constant/cosine/linear, got "
+                f"{t.lr_schedule!r}")
+        if t.lr_warmup_steps < 0 or t.lr_warmup_steps > t.total_train_steps:
+            raise ValueError(
+                f"lr_warmup_steps must be in [0, total_train_steps], got "
+                f"{t.lr_warmup_steps}")
+        if not 0.0 <= t.lr_min_ratio <= 1.0:
+            # a negative ratio would drive the decayed LR below zero and
+            # silently ascend the loss late in training
+            raise ValueError(
+                f"lr_min_ratio must be in [0, 1], got {t.lr_min_ratio}")
+        if t.ce_chunk_size < 0:
+            raise ValueError(
+                f"ce_chunk_size must be >= 0, got {t.ce_chunk_size}")
+        if t.ce_chunk_size > 0:
+            vshard = m.vocab_size // d.tp_size
+            if vshard % t.ce_chunk_size != 0 and t.ce_chunk_size < vshard:
+                # a non-dividing chunk would silently fall back to the
+                # fused path — the user set the knob to AVOID that memory
+                raise ValueError(
+                    f"ce_chunk_size ({t.ce_chunk_size}) must divide the "
+                    f"per-tp-shard vocab (vocab_size/tp_size = {vshard})")
         lg = self.logging
         if lg.profile_dir is not None:
             if lg.profile_start_step < 1:

@@ -59,19 +59,44 @@ def scale_by_adam_low_moments(b1: float, b2: float, eps: float,
     return optax.GradientTransformation(init, update)
 
 
+def make_lr(t: TrainingConfig):
+    """Learning-rate schedule (a float or an optax schedule fn). The
+    reference trains at constant LR (ref: train.py:209); warmup + cosine /
+    linear decay are the standard pretraining extensions. Schedules are a
+    pure function of the optimizer step count, which lives in the restored
+    optimizer state — resume continues the schedule where it left off."""
+    if t.lr_schedule == "constant" and t.lr_warmup_steps == 0:
+        return t.learning_rate
+    peak, floor = t.learning_rate, t.learning_rate * t.lr_min_ratio
+    decay_steps = max(1, t.total_train_steps - t.lr_warmup_steps)
+    if t.lr_schedule == "cosine":
+        decay = optax.cosine_decay_schedule(peak, decay_steps,
+                                            alpha=t.lr_min_ratio)
+    elif t.lr_schedule == "linear":
+        decay = optax.linear_schedule(peak, floor, decay_steps)
+    else:  # constant with warmup
+        decay = optax.constant_schedule(peak)
+    if t.lr_warmup_steps == 0:
+        return decay
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, peak, t.lr_warmup_steps), decay],
+        boundaries=[t.lr_warmup_steps])
+
+
 def make_optimizer(t: TrainingConfig) -> optax.GradientTransformation:
+    lr = make_lr(t)
     steps = [] if t.grad_clip_norm <= 0 else [optax.clip_by_global_norm(t.grad_clip_norm)]
     if t.adam_moments_dtype == "bfloat16":
         steps += [
             scale_by_adam_low_moments(t.adam_beta1, t.adam_beta2, t.adam_eps,
                                       jnp.bfloat16),
             optax.add_decayed_weights(t.weight_decay),
-            optax.scale_by_learning_rate(t.learning_rate),
+            optax.scale_by_learning_rate(lr),
         ]
     else:
         steps.append(
             optax.adamw(
-                learning_rate=t.learning_rate,
+                learning_rate=lr,
                 b1=t.adam_beta1,
                 b2=t.adam_beta2,
                 eps=t.adam_eps,

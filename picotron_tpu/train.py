@@ -33,10 +33,14 @@ from picotron_tpu.utils import (
 )
 
 
-def build_state(cfg: Config, menv: MeshEnv) -> tuple[TrainState, int, int, dict]:
-    """(state, start_step, trained_tokens, ckpt_meta) — fresh init, HF
-    weights, or resume, in the reference's precedence (ref: train.py:174-215:
-    materialize weights, then load_checkpoint overrides)."""
+def build_state(cfg: Config, menv: MeshEnv) \
+        -> tuple[TrainState, int, int, dict, str]:
+    """(state, start_step, trained_tokens, ckpt_meta, resumed_from) — fresh
+    init, HF weights, or resume, in the reference's precedence (ref:
+    train.py:174-215: materialize weights, then load_checkpoint overrides).
+    `resumed_from` is the checkpoint directory the state came from ("" when
+    fresh): with auto_resume and no explicit load_path, the newest durable
+    checkpoint in save_dir wins — preemption recovery."""
     state = init_sharded_state(cfg, menv, jax.random.key(cfg.training.seed))
 
     if cfg.checkpoint.init_from_hf:
@@ -49,14 +53,24 @@ def build_state(cfg: Config, menv: MeshEnv) -> tuple[TrainState, int, int, dict]
                            step=state.step)
         log_print(f"initialized weights from {cfg.checkpoint.init_from_hf}")
 
-    if cfg.checkpoint.load_path:
-        mgr = CheckpointManager(cfg, menv, directory=cfg.checkpoint.load_path)
+    load_dir = cfg.checkpoint.load_path
+    mgr = None
+    if not load_dir and cfg.checkpoint.auto_resume:
+        probe = CheckpointManager(cfg, menv)
+        if probe.latest_step() is not None:
+            load_dir = probe.directory
+            mgr = probe  # same dir — reuse, don't build a second manager
+            log_print(f"auto_resume: found checkpoints in {load_dir}")
+
+    if load_dir:
+        if mgr is None:
+            mgr = CheckpointManager(cfg, menv, directory=load_dir)
         state, meta = mgr.restore(state)
         tokens = meta.get("trained_tokens", 0)
-        log_print(f"resumed from {cfg.checkpoint.load_path} at step "
+        log_print(f"resumed from {load_dir} at step "
                   f"{int(state.step)} ({human_format(tokens)} tokens)")
-        return state, int(state.step), tokens, meta
-    return state, 0, 0, {}
+        return state, int(state.step), tokens, meta, load_dir
+    return state, 0, 0, {}, ""
 
 
 def main(argv=None) -> None:
@@ -104,7 +118,8 @@ def main(argv=None) -> None:
     )
 
     dl = MicroBatchDataLoader(cfg, menv)
-    state, start_step, trained_tokens, ckpt_meta = build_state(cfg, menv)
+    (state, start_step, trained_tokens, ckpt_meta,
+     resumed_from) = build_state(cfg, menv)
     if start_step > 0:
         # Fast-forward the dataloader so resume does not replay consumed
         # data (ADVICE r1). Checkpoints record the exact position; for ones
@@ -143,11 +158,12 @@ def main(argv=None) -> None:
     timer = StepTimer()
     last_logged_step = start_step
     # Steps whose checkpoint already exists in the SAVE directory: the loaded
-    # step counts only when load_path is the save dir (resuming in place) —
-    # resuming from elsewhere must still write a final save into save_dir.
+    # step counts only when the resume source IS the save dir (explicit
+    # load_path there, or auto_resume) — resuming from elsewhere must still
+    # write a final save into save_dir.
     resumed_in_place = (
-        cfg.checkpoint.load_path
-        and os.path.abspath(cfg.checkpoint.load_path)
+        resumed_from
+        and os.path.abspath(resumed_from)
         == os.path.abspath(cfg.checkpoint.save_dir))
     saved_steps = {start_step} if resumed_in_place else set()
     prof = cfg.logging  # trace capture window (config.py LoggingConfig)

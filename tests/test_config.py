@@ -81,3 +81,20 @@ def test_num_params_llama2_7b():
     n = num_params(m)
     # ~6.74B params + untied head
     assert 6.5e9 < n < 7.1e9
+
+
+def test_ce_chunk_and_lr_ratio_validation():
+    import pytest
+
+    from picotron_tpu.config import Config, TrainingConfig
+
+    with pytest.raises(ValueError, match="ce_chunk_size"):
+        Config(training=TrainingConfig(ce_chunk_size=-16)).validate()
+    # non-dividing chunk would silently fall back to fused — reject
+    with pytest.raises(ValueError, match="divide"):
+        Config(training=TrainingConfig(ce_chunk_size=100)).validate()
+    Config(training=TrainingConfig(ce_chunk_size=64)).validate()  # 256 % 64
+    # chunk >= vocab shard is a harmless no-op request
+    Config(training=TrainingConfig(ce_chunk_size=512)).validate()
+    with pytest.raises(ValueError, match="lr_min_ratio"):
+        Config(training=TrainingConfig(lr_min_ratio=-0.1)).validate()

@@ -204,3 +204,60 @@ def test_sdpa_gqa_internal_expansion():
     got = sdpa_attention(q, k, v, causal=True)
     want = sdpa_attention(q, repeat_kv(k, 2), repeat_kv(v, 2), causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6)
+
+
+def test_lr_schedules():
+    """Warmup + cosine/linear decay shapes; constant stays the reference's
+    behavior (ref: train.py:209 bare AdamW)."""
+    from picotron_tpu.config import TrainingConfig
+    from picotron_tpu.optimizer import make_lr
+
+    t = TrainingConfig(learning_rate=1e-3, total_train_steps=100,
+                       lr_schedule="cosine", lr_warmup_steps=10,
+                       lr_min_ratio=0.1)
+    lr = make_lr(t)
+    assert float(lr(0)) == 0.0
+    np.testing.assert_allclose(float(lr(10)), 1e-3, rtol=1e-6)
+    np.testing.assert_allclose(float(lr(100)), 1e-4, rtol=1e-3)  # floor
+    assert float(lr(50)) < 1e-3
+
+    t = TrainingConfig(learning_rate=1e-3, total_train_steps=100,
+                       lr_schedule="linear", lr_warmup_steps=0,
+                       lr_min_ratio=0.5)
+    lr = make_lr(t)
+    np.testing.assert_allclose(float(lr(0)), 1e-3, rtol=1e-6)
+    np.testing.assert_allclose(float(lr(100)), 5e-4, rtol=1e-6)
+
+    t = TrainingConfig(learning_rate=1e-3)
+    assert make_lr(t) == 1e-3  # plain constant: no schedule object at all
+
+    with pytest.raises(ValueError, match="lr_schedule"):
+        Config(training=TrainingConfig(lr_schedule="step")).validate()
+
+
+def test_lr_schedule_trains_and_resumes_with_optimizer_step():
+    """The schedule reads the optimizer's own step count, so a restored
+    state continues the schedule (not restarts warmup)."""
+    from picotron_tpu.config import TrainingConfig
+    from picotron_tpu.train_step import init_train_state, make_train_step
+
+    cfg = Config(
+        model=ModelConfig(dtype="float32"),
+        training=TrainingConfig(learning_rate=1e-3, seq_length=32,
+                                micro_batch_size=2,
+                                gradient_accumulation_steps=1,
+                                total_train_steps=10,
+                                lr_schedule="cosine", lr_warmup_steps=3),
+    )
+    p = init_params(cfg.model, jax.random.key(0))
+    state = init_train_state(cfg, p)
+    step = jax.jit(make_train_step(cfg))
+    ids = jax.random.randint(jax.random.key(1), (1, 2, 33), 0,
+                             cfg.model.vocab_size)
+    batch = (ids[..., :-1], ids[..., 1:])
+    p0 = np.asarray(p["embedding"]).copy()
+    state, _ = step(state, batch)
+    # warmup step 0: lr == 0 -> params untouched (AdamW update scaled by 0)
+    np.testing.assert_array_equal(np.asarray(state.params["embedding"]), p0)
+    state, _ = step(state, batch)
+    assert not np.array_equal(np.asarray(state.params["embedding"]), p0)

@@ -89,6 +89,32 @@ def test_trainer_resume_continues_data_and_steps(tmp_path, capsys):
     assert meta2["dataloader"] == {"epoch": 0, "cursor": 48}
 
 
+def test_trainer_auto_resume_preemption_recovery(tmp_path, capsys):
+    """auto_resume with no load_path: the same config re-run (as after a
+    preemption + scheduler resubmission) continues from save_dir's newest
+    durable checkpoint; a fresh run (empty save_dir) starts from scratch."""
+    cfg = write_cfg(
+        tmp_path,
+        training={"total_train_steps": 3},
+        checkpoint={"save_frequency": 2, "auto_resume": True})
+    out1 = run_main(cfg, capsys)
+    rows1 = [int(m.group("step")) for line in out1.splitlines()
+             if (m := LINE_RE.search(line))]
+    assert rows1 == [1, 2, 3]  # empty save_dir: fresh start
+
+    # "preemption": the process died after the step-2 save; resubmission
+    # reruns the identical config with a higher budget
+    cfg2 = write_cfg(
+        tmp_path, name="resub.json",
+        training={"total_train_steps": 5},
+        checkpoint={"save_frequency": 2, "auto_resume": True})
+    out2 = run_main(cfg2, capsys)
+    assert "auto_resume: found checkpoints" in out2
+    rows2 = [int(m.group("step")) for line in out2.splitlines()
+             if (m := LINE_RE.search(line))]
+    assert rows2 == [4, 5]  # resumed at the final step-3 save
+
+
 def test_trainer_max_tokens_stops_early(tmp_path, capsys):
     # 3 steps' worth of tokens (ceil): 2.5 steps -> stops after step 3
     cfg = write_cfg(

@@ -57,6 +57,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--grad-acc", type=int, default=1)
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--learning-rate", type=float, default=3e-4)
+    p.add_argument("--lr-schedule", default="constant",
+                   choices=["constant", "cosine", "linear"])
+    p.add_argument("--lr-warmup-steps", type=int, default=0)
     p.add_argument("--total-train-steps", type=int, default=200)
     p.add_argument("--no-remat", action="store_true")
     # dataset
@@ -66,6 +69,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tokenizer", default=None)
     # checkpoint / logging
     p.add_argument("--save-frequency", type=int, default=0)
+    p.add_argument("--auto-resume", action="store_true",
+                   help="resume from the newest durable checkpoint in the "
+                        "save dir when the job (re)starts — pairs with "
+                        "submit_jobs' failure resubmission so preempted "
+                        "jobs continue instead of restarting")
     p.add_argument("--download-model", action="store_true",
                    help="snapshot the model's HF safetensors (tools/"
                         "download_model.py; ref: create_config.py:134) and "
@@ -109,6 +117,8 @@ def create_single_config(args) -> str:
             "micro_batch_size": args.mbs,
             "gradient_accumulation_steps": args.grad_acc,
             "learning_rate": args.learning_rate,
+            "lr_schedule": args.lr_schedule,
+            "lr_warmup_steps": args.lr_warmup_steps,
             "total_train_steps": args.total_train_steps,
             "remat": not args.no_remat,
         },
@@ -116,7 +126,8 @@ def create_single_config(args) -> str:
             "name": args.dataset, "subset_name": args.subset,
             "split": args.split, "tokenizer_name": args.tokenizer,
         },
-        "checkpoint": {"save_frequency": args.save_frequency},
+        "checkpoint": {"save_frequency": args.save_frequency,
+                       "auto_resume": args.auto_resume},
         "logging": {"use_wandb": args.use_wandb, "run_name": args.exp_name},
     }
     if getattr(args, "download_model", False):
