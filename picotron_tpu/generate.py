@@ -1,0 +1,184 @@
+"""Autoregressive generation with a KV cache (beyond the reference, which
+is training-only — a framework needs a decode path to inspect what it
+trained).
+
+TPU-first decode design:
+
+- **Static shapes throughout**: the cache is allocated at `max_length` up
+  front; prefill writes the prompt's K/V in one batched pass, and each
+  decode step updates one slot via `lax.dynamic_update_slice` inside a
+  `lax.scan` — one compiled program for any prompt/generation length up to
+  the cap, no retracing per token.
+- **Attention against the cache is plain jnp** (fp32 softmax over
+  [B, Hq, s, S_max]): decode is a GEMV-shaped, HBM-bound workload where a
+  flash kernel buys nothing; XLA fuses the mask/softmax fine. GQA stays
+  unexpanded in the cache (Hkv heads) and queries are grouped at score
+  time, so cache memory is Hkv/Hq of the naive layout.
+- **Weight-compatible with training**: same param pytree (train ->
+  generate without conversion), same RoPE/RMSNorm helpers, and the MLP /
+  MoE blocks are the training ones (a Mixtral checkpoint decodes through
+  the same capacity-bounded expert dispatch it trained with).
+
+Single-device by design (sampling is an interactive/debug path; sharded
+batch inference is a serving system's job, not this framework's). Sampling:
+greedy (temperature=0), temperature, and top-k.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from picotron_tpu.config import ModelConfig
+from picotron_tpu.models.llama import (
+    DEFAULT_CTX, _mlp_block, _moe_block, compute_dtype, final_hidden,
+    rms_norm,
+)
+from picotron_tpu.ops.rope import apply_rope, rope_tables
+
+
+class KVCache(NamedTuple):
+    """Per-layer key/value cache, [L, B, S_max, Hkv, D] each."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_length: int) -> KVCache:
+    shape = (cfg.num_hidden_layers, batch, max_length,
+             cfg.num_key_value_heads, cfg.head_dim)
+    dt = compute_dtype(cfg)
+    return KVCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+
+
+def _cached_attention(q, ck, cv, q_pos):
+    """q: [B, s, Hq, D] at global positions q_pos [s]; ck/cv: [B, S_max,
+    Hkv, D] with slot j holding the token at position j (zeros beyond the
+    filled length — masked out by causality, since every filled slot index
+    <= max(q_pos)). Returns [B, s, Hq, D]."""
+    b, s, hq, d = q.shape
+    s_max, hkv = ck.shape[1], ck.shape[2]
+    group = hq // hkv
+    qg = q.reshape(b, s, hkv, group, d)
+    # [B, Hkv, G, s, S_max]
+    scores = jnp.einsum("bshgd,bthd->bhgst", qg, ck).astype(jnp.float32)
+    scores = scores / (d ** 0.5)
+    mask = jnp.arange(s_max)[None, :] <= q_pos[:, None]  # [s, S_max]
+    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgst,bthd->bshgd", p, cv)
+    return out.reshape(b, s, hq, d)
+
+
+def _decode_layers(params, x, cache: KVCache, q_pos, cfg: ModelConfig,
+                   cos, sin):
+    """Run every layer over x [B, s, H] (prefill: s = prompt length,
+    decode: s = 1), writing this segment's K/V into the cache at slots
+    q_pos[0]..q_pos[-1]. Returns (hidden, cache)."""
+    dt = x.dtype
+    d = cfg.head_dim
+    start = q_pos[0]
+
+    def body(x, inputs):
+        lp, ck_l, cv_l = inputs
+        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        b, s, _ = h.shape
+        q = (h @ lp["q"].astype(dt)).reshape(b, s, -1, d)
+        k = (h @ lp["k"].astype(dt)).reshape(b, s, -1, d)
+        v = (h @ lp["v"].astype(dt)).reshape(b, s, -1, d)
+        q = apply_rope(q, cos, sin, q_pos)
+        k = apply_rope(k, cos, sin, q_pos)
+        ck_l = lax.dynamic_update_slice(ck_l, k, (0, start, 0, 0))
+        cv_l = lax.dynamic_update_slice(cv_l, v, (0, start, 0, 0))
+        out = _cached_attention(q, ck_l, cv_l, q_pos)
+        out = out.reshape(b, s, -1) @ lp["o"].astype(dt)
+        x = x + out
+        if cfg.num_experts:
+            mlp_out, _ = _moe_block(x, lp, cfg, DEFAULT_CTX)
+        else:
+            mlp_out = _mlp_block(x, lp, cfg, DEFAULT_CTX)
+        return x + mlp_out, (ck_l, cv_l)
+
+    x, (ck, cv) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    return x, KVCache(ck, cv)
+
+
+def _logits_last(params, x, cfg: ModelConfig):
+    """Logits of the LAST position only: [B, V] fp32."""
+    hf = final_hidden(params, x[:, -1:], cfg)
+    return (hf @ params["lm_head"].astype(hf.dtype))[:, 0].astype(jnp.float32)
+
+
+def _sample(logits, temperature: float, top_k: int, key):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature",
+                                   "top_k", "eos_token_id"))
+def _generate_jit(params, prompt_ids, cfg: ModelConfig,
+                  max_new_tokens: int, temperature: float, top_k: int,
+                  eos_token_id: Optional[int], key):
+    b, p_len = prompt_ids.shape
+    max_len = p_len + max_new_tokens
+    cos, sin = rope_tables(max(cfg.max_position_embeddings, max_len),
+                           cfg.head_dim, cfg.rope_theta)
+    cache = init_cache(cfg, b, max_len)
+
+    # prefill: one batched pass over the prompt
+    x = params["embedding"][prompt_ids].astype(compute_dtype(cfg))
+    x, cache = _decode_layers(params, x, cache, jnp.arange(p_len), cfg,
+                              cos, sin)
+    logits = _logits_last(params, x, cfg)
+    key, sub = jax.random.split(key)
+    tok = _sample(logits, temperature, top_k, sub)
+    done = (jnp.full((b,), False) if eos_token_id is None
+            else tok == eos_token_id)
+
+    def step(carry, i):
+        tok, cache, done, key = carry
+        # iteration i feeds the token SAMPLED at step i-1, which sits at
+        # sequence position p_len + i - 1 (an off-by-one here rotates RoPE
+        # wrong, writes K/V one slot late, and attends a never-written
+        # zero slot — caught by code review r3 + the greedy parity test)
+        pos = p_len + i - 1
+        x = params["embedding"][tok[:, None]].astype(compute_dtype(cfg))
+        x, cache = _decode_layers(params, x, cache, pos[None], cfg, cos, sin)
+        logits = _logits_last(params, x, cfg)
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits, temperature, top_k, sub)
+        if eos_token_id is not None:
+            nxt = jnp.where(done, eos_token_id, nxt)
+            done = done | (nxt == eos_token_id)
+        return (nxt, cache, done, key), tok
+
+    (last, _, _, _), toks = lax.scan(
+        step, (tok, cache, done, key), jnp.arange(1, max_new_tokens))
+    # toks stacks the PREVIOUS token per step; append the final one
+    out = jnp.concatenate([toks.T, last[:, None]], axis=1)  # [B, N]
+    return jnp.concatenate([prompt_ids, out], axis=1)
+
+
+def generate(params, cfg: ModelConfig, prompt_ids, max_new_tokens: int,
+             *, temperature: float = 0.0, top_k: int = 0,
+             eos_token_id: Optional[int] = None,
+             key: Optional[jax.Array] = None) -> jnp.ndarray:
+    """prompt_ids [B, P] int32 -> [B, P + max_new_tokens] (tokens after an
+    EOS are padded with EOS when eos_token_id is given). One compile per
+    (shape, sampling-config); greedy when temperature == 0."""
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if key is None:
+        key = jax.random.key(0)
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    return _generate_jit(params, prompt_ids, cfg, max_new_tokens,
+                         float(temperature), int(top_k), eos_token_id, key)

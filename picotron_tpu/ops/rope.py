@@ -52,13 +52,17 @@ def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
     else:
         # Bounds-check when positions are concrete (tracers — e.g. computed
         # from axis_index inside shard_map — can't be checked at trace time;
-        # out-of-range gathers would silently clamp).
+        # out-of-range gathers would silently clamp). The max itself can
+        # come back traced even for a concrete `positions` when this runs
+        # under an outer trace (a scan body closing over constant
+        # positions), so the guard checks the RESULT, not the input.
         if not isinstance(positions, jax.core.Tracer):
-            pmax = int(jnp.max(positions))
-            if pmax >= cos.shape[0]:
+            pmax = jnp.max(positions)
+            if not isinstance(pmax, jax.core.Tracer) \
+                    and int(pmax) >= cos.shape[0]:
                 raise ValueError(
-                    f"position {pmax} exceeds the RoPE table length {cos.shape[0]}"
-                )
+                    f"position {int(pmax)} exceeds the RoPE table length "
+                    f"{cos.shape[0]}")
         c = cos[positions]
         s = sin[positions]
     c = c[None, :, None, :]  # [1, S, 1, D/2]

@@ -1,0 +1,126 @@
+"""Generation tests: the KV-cache decode path must reproduce the training
+forward exactly (greedy decode == argmax over a full recompute at every
+step), plus sampling/EOS mechanics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from picotron_tpu.config import ModelConfig, resolve_preset
+from picotron_tpu.generate import generate, init_cache
+from picotron_tpu.models.llama import forward, init_params
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = ModelConfig(dtype="float32", **{
+        **resolve_preset("debug-tiny"), "max_position_embeddings": 64})
+    params = init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def teacher_forced_cache_logits(params, cfg, ids):
+    """Per-position logits from the KV-cache path: prefill on ids[:, :1],
+    then decode each given token — the cache must reproduce the full
+    forward's logits (token-exact sequence comparison would be brittle:
+    greedy argmax flips on fp near-ties and the sequences then diverge
+    completely, telling us nothing about cache correctness)."""
+    from picotron_tpu.generate import _decode_layers, _logits_last, init_cache
+    from picotron_tpu.models.llama import compute_dtype
+    from picotron_tpu.ops.rope import rope_tables
+
+    b, n = ids.shape
+    cos, sin = rope_tables(cfg.max_position_embeddings, cfg.head_dim,
+                           cfg.rope_theta)
+    cache = init_cache(cfg, b, n)
+    outs = []
+    for t in range(n):
+        x = params["embedding"][ids[:, t:t + 1]].astype(compute_dtype(cfg))
+        x, cache = _decode_layers(params, x, cache, jnp.array([t]), cfg,
+                                  cos, sin)
+        outs.append(_logits_last(params, x, cfg))
+    return jnp.stack(outs, axis=1)  # [B, N, V]
+
+
+def test_cache_decode_logits_match_full_forward(tiny):
+    cfg, params = tiny
+    ids = jax.random.randint(jax.random.key(1), (2, 12), 0, cfg.vocab_size)
+    want = forward(params, cfg=cfg, input_ids=ids).astype(jnp.float32)
+    got = teacher_forced_cache_logits(params, cfg, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_cache_decode_logits_match_full_forward_moe():
+    cfg = ModelConfig(dtype="float32", **{
+        **resolve_preset("debug-tiny-moe"), "max_position_embeddings": 64,
+        # decode batches are tiny; keep capacity loose so the expert path
+        # matches the full-recompute reference (no drops). NOTE: routing is
+        # still per-call, so capacity slots differ between a 12-token batch
+        # and 12 single-token calls — drop-free capacity makes them equal.
+        "capacity_factor": 64.0})
+    params = init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab_size)
+    want = forward(params, cfg=cfg, input_ids=ids).astype(jnp.float32)
+    got = teacher_forced_cache_logits(params, cfg, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_greedy_generate_matches_full_recompute(tiny):
+    """End-to-end greedy generate through _generate_jit's scan (positions,
+    cache slots, sampling) vs a full-forward recompute per step. This is
+    the test that catches decode-position off-by-ones (code review r3: the
+    scan fed token i at position p_len+i instead of p_len+i-1 and the
+    teacher-forced tests, which hand-build positions, stayed green). A few
+    greedy steps on an fp32 tiny model carry no practical argmax-tie
+    hazard."""
+    cfg, params = tiny
+    prompt = jax.random.randint(jax.random.key(1), (2, 7), 0, cfg.vocab_size)
+    ids = jnp.asarray(prompt, jnp.int32)
+    for _ in range(4):
+        logits = forward(params, ids, cfg)
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
+        ids = jnp.concatenate([ids, nxt[:, None].astype(jnp.int32)], axis=1)
+    got = generate(params, cfg, prompt, max_new_tokens=4)
+    assert got.shape == (2, 11) and got.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ids))
+
+
+def test_sampling_shapes_and_determinism(tiny):
+    cfg, params = tiny
+    prompt = jnp.zeros((3, 4), jnp.int32)
+    a = generate(params, cfg, prompt, 5, temperature=0.8, top_k=10,
+                 key=jax.random.key(7))
+    b = generate(params, cfg, prompt, 5, temperature=0.8, top_k=10,
+                 key=jax.random.key(7))
+    assert a.shape == (3, 9)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = generate(params, cfg, prompt, 5, temperature=0.8, top_k=10,
+                 key=jax.random.key(8))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_eos_padding(tiny):
+    cfg, params = tiny
+    prompt = jax.random.randint(jax.random.key(2), (2, 4), 0, cfg.vocab_size)
+    # force every token to be EOS by choosing eos == the greedy argmax of
+    # the first step for row 0: cheaper — just use a vocab-wide sweep:
+    # generate with eos_token_id set to whatever greedy produced first.
+    greedy = generate(params, cfg, prompt, 6)
+    eos = int(greedy[0, 4])  # row 0's first generated token
+    out = np.asarray(generate(params, cfg, prompt, 6, eos_token_id=eos))
+    # once a row hits eos, everything after must be eos
+    for row in out:
+        gen = row[4:]
+        hits = np.where(gen == eos)[0]
+        if hits.size:
+            assert (gen[hits[0]:] == eos).all()
+
+
+def test_cache_shapes(tiny):
+    cfg, params = tiny
+    cache = init_cache(cfg, batch=2, max_length=16)
+    assert cache.k.shape == (cfg.num_hidden_layers, 2, 16,
+                             cfg.num_key_value_heads, cfg.head_dim)

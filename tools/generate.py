@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Text generation CLI over a trained or imported checkpoint.
+
+The reference is training-only; this is the inspect-what-you-trained path.
+
+  # from an HF safetensors dir (tools/download_model.py or any HF export)
+  python tools/generate.py --model SmolLM-360M --hf-dir ./hf_model \\
+      --prompt "The capital of France is" --max-new-tokens 32
+
+  # from a framework checkpoint (checkpoint.save_dir of a training run)
+  python tools/generate.py --config runs/smoke/config.json \\
+      --ckpt-dir ckpt --prompt-ids 12,7,99 --max-new-tokens 16
+
+Zero-egress note: --prompt needs the model's tokenizer (transformers);
+--prompt-ids takes raw token ids and needs nothing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="picotron-tpu generation")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--hf-dir", help="HF safetensors directory")
+    src.add_argument("--ckpt-dir", help="framework checkpoint save_dir")
+    ap.add_argument("--model", default=None,
+                    help="model preset name (required with --hf-dir)")
+    ap.add_argument("--config", default=None,
+                    help="training config JSON (required with --ckpt-dir)")
+    prompt = ap.add_mutually_exclusive_group(required=True)
+    prompt.add_argument("--prompt", help="text (needs the HF tokenizer)")
+    prompt.add_argument("--prompt-ids",
+                        help="comma-separated raw token ids")
+    ap.add_argument("--max-new-tokens", type=int, default=64)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-k", type=int, default=0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    from picotron_tpu.config import (
+        Config, ModelConfig, load_config, resolve_hf_name, resolve_preset,
+    )
+    from picotron_tpu.generate import generate
+
+    if args.hf_dir:
+        if not args.model:
+            ap.error("--hf-dir needs --model <preset>")
+        from picotron_tpu.checkpoint import load_hf_safetensors
+
+        cfg_m = ModelConfig(name=args.model, **resolve_preset(args.model))
+        params = load_hf_safetensors(args.hf_dir, cfg_m)
+    else:
+        if not args.config:
+            ap.error("--ckpt-dir needs --config <json>")
+        cfg: Config = load_config(args.config)
+        cfg_m = cfg.model
+        from picotron_tpu.checkpoint import CheckpointManager
+        from picotron_tpu.mesh import MeshEnv
+        from picotron_tpu.models.llama import pad_layers_for_pp, unpad_layers
+        from picotron_tpu.parallel.api import init_sharded_state
+        from picotron_tpu.train_step import TrainState
+
+        menv = MeshEnv.create(dp=1, devices=jax.devices()[:1])
+        single = Config(model=cfg.model, training=cfg.training)
+        template = init_sharded_state(single, menv, jax.random.key(0))
+        # Checkpoints store the PP-padded layer stack of the training run's
+        # pp_size — the restore template (params AND the param-shaped Adam
+        # moment subtrees) must match that shape; the canonical [L] stack
+        # is gathered back out for decoding.
+        nl, pp = cfg_m.num_hidden_layers, cfg.distributed.pp_size
+        params_treedef = jax.tree.structure(template.params)
+
+        def pad_sub(sub):
+            if jax.tree.structure(sub) == params_treedef:
+                return pad_layers_for_pp(sub, nl, pp)
+            return sub
+
+        opt_padded = jax.tree.map(
+            pad_sub, template.opt_state,
+            is_leaf=lambda x: jax.tree.structure(x) == params_treedef)
+        template = TrainState(
+            params=pad_layers_for_pp(template.params, nl, pp),
+            opt_state=opt_padded, step=template.step)
+        mgr = CheckpointManager(cfg, menv, directory=args.ckpt_dir)
+        state, _ = mgr.restore(template)
+        params = unpad_layers(state.params, cfg_m.num_hidden_layers,
+                              cfg.distributed.pp_size)
+
+    tokenizer = None
+    if args.prompt is not None:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(resolve_hf_name(cfg_m.name))
+        ids = tokenizer(args.prompt, return_tensors="np")["input_ids"]
+    else:
+        ids = [[int(t) for t in args.prompt_ids.split(",")]]
+    ids = jnp.asarray(ids, jnp.int32)
+
+    eos = (tokenizer.eos_token_id if tokenizer is not None else None)
+    out = generate(params, cfg_m, ids, args.max_new_tokens,
+                   temperature=args.temperature, top_k=args.top_k,
+                   eos_token_id=eos, key=jax.random.key(args.seed))
+    out = jax.device_get(out)
+    if tokenizer is not None:
+        print(tokenizer.decode(out[0], skip_special_tokens=True))
+    else:
+        print(",".join(str(int(t)) for t in out[0]))
+
+
+if __name__ == "__main__":
+    main()
