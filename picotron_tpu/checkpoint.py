@@ -232,6 +232,13 @@ _LAYER_MAP = {
     "mlp.down_proj.weight": ("down", True),
 }
 
+# Qwen2-style qkv bias (HF stores [out_features]; no transpose).
+_BIAS_MAP = {
+    "self_attn.q_proj.bias": ("b_q", False),
+    "self_attn.k_proj.bias": ("b_k", False),
+    "self_attn.v_proj.bias": ("b_v", False),
+}
+
 # Mixtral MoE expert naming: block_sparse_moe.experts.<j>.{w1,w2,w3} hold
 # gate/down/up projections, block_sparse_moe.gate is the router.
 _MOE_EXPERT_MAP = {"w1": "w_gate", "w2": "w_down", "w3": "w_up"}
@@ -272,7 +279,9 @@ def load_hf_safetensors(path: str, cfg: ModelConfig,
                 f"{len(raw)} tensors)")
         return raw[name].astype(np.float32)
 
-    lmap = _ATTN_MAP if cfg.num_experts else _LAYER_MAP
+    lmap = dict(_ATTN_MAP if cfg.num_experts else _LAYER_MAP)
+    if cfg.attention_bias:
+        lmap.update(_BIAS_MAP)
     layers: dict[str, list[np.ndarray]] = {k: [] for k, _ in lmap.values()}
     if cfg.num_experts:
         layers.update({k: [] for k in ("router", "w_gate", "w_up", "w_down")})
@@ -290,20 +299,24 @@ def load_hf_safetensors(path: str, cfg: ModelConfig,
                 layers[key].append(np.stack(bank))  # [E, in, out]
 
     embedding = get("model.embed_tokens.weight")  # [vocab, hidden]
-    if "lm_head.weight" in raw:
-        lm_head = get("lm_head.weight").T  # [hidden, vocab]
-    else:
-        # Tied-head checkpoint: untie by copying (ref: checkpoint.py:88-91
-        # force-creates lm_head for the same reason).
-        lm_head = embedding.T.copy()
-
     params = {
         "embedding": jnp.asarray(embedding, dtype),
         "layers": {k: jnp.asarray(np.stack(v), dtype)
                    for k, v in layers.items()},
         "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
-        "lm_head": jnp.asarray(lm_head, dtype),
     }
+    if cfg.tie_word_embeddings:
+        # Qwen2-style tying: no lm_head parameter; head_weight() reads the
+        # embedding. (A stray lm_head.weight in the file is ignored — HF
+        # does the same for tied configs.)
+        return params
+    if "lm_head.weight" in raw:
+        lm_head = get("lm_head.weight").T  # [hidden, vocab]
+    else:
+        # Tied-head checkpoint loaded as an UNTIED model: untie by copying
+        # (ref: checkpoint.py:88-91 force-creates lm_head the same way).
+        lm_head = embedding.T.copy()
+    params["lm_head"] = jnp.asarray(lm_head, dtype)
     return params
 
 
@@ -316,11 +329,14 @@ def save_hf_safetensors(params: dict[str, Any], path: str) -> None:
     out: dict[str, np.ndarray] = {}
     out["model.embed_tokens.weight"] = np.asarray(params["embedding"])
     out["model.norm.weight"] = np.asarray(params["final_norm"])
-    out["lm_head.weight"] = np.asarray(params["lm_head"]).T
+    if "lm_head" in params:  # tied models carry no separate head
+        out["lm_head.weight"] = np.asarray(params["lm_head"]).T
     layers = params["layers"]
     nl = next(iter(layers.values())).shape[0]
     is_moe = "router" in layers
-    lmap = _ATTN_MAP if is_moe else _LAYER_MAP
+    lmap = dict(_ATTN_MAP if is_moe else _LAYER_MAP)
+    if "b_q" in layers:
+        lmap.update(_BIAS_MAP)
     for i in range(nl):
         prefix = f"model.layers.{i}."
         for suffix, (key, transpose) in lmap.items():

@@ -69,6 +69,26 @@ MODEL_PRESETS: dict[str, dict[str, Any]] = {
         num_hidden_layers=22, num_attention_heads=32, num_key_value_heads=4,
         max_position_embeddings=2048, rope_theta=10000.0, rms_norm_eps=1e-5,
     ),
+    # Qwen2 family (Llama-like + attention qkv bias; small ones tie the
+    # LM head to the embedding)
+    "Qwen/Qwen2-0.5B": dict(
+        vocab_size=151936, hidden_size=896, intermediate_size=4864,
+        num_hidden_layers=24, num_attention_heads=14, num_key_value_heads=2,
+        max_position_embeddings=32768, rope_theta=1e6, rms_norm_eps=1e-6,
+        attention_bias=True, tie_word_embeddings=True,
+    ),
+    "Qwen/Qwen2-1.5B": dict(
+        vocab_size=151936, hidden_size=1536, intermediate_size=8960,
+        num_hidden_layers=28, num_attention_heads=12, num_key_value_heads=2,
+        max_position_embeddings=32768, rope_theta=1e6, rms_norm_eps=1e-6,
+        attention_bias=True, tie_word_embeddings=True,
+    ),
+    "Qwen/Qwen2-7B": dict(
+        vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+        num_hidden_layers=28, num_attention_heads=28, num_key_value_heads=4,
+        max_position_embeddings=32768, rope_theta=1e6, rms_norm_eps=1e-6,
+        attention_bias=True,
+    ),
     # Mixtral (MoE family; beyond the reference's dense-only coverage)
     "mistralai/Mixtral-8x7B-v0.1": dict(
         vocab_size=32000, hidden_size=4096, intermediate_size=14336,
@@ -81,6 +101,13 @@ MODEL_PRESETS: dict[str, dict[str, Any]] = {
         vocab_size=256, hidden_size=64, intermediate_size=128,
         num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
         max_position_embeddings=2048, rope_theta=10000.0, rms_norm_eps=1e-5,
+    ),
+    # Tiny Qwen2-style debug model (qkv bias + tied embeddings)
+    "picotron-tpu/debug-tiny-qwen": dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=2048, rope_theta=10000.0, rms_norm_eps=1e-5,
+        attention_bias=True, tie_word_embeddings=True,
     ),
     # Tiny MoE debug model (8 experts, top-2)
     "picotron-tpu/debug-tiny-moe": dict(
@@ -103,7 +130,11 @@ _PRESET_ALIASES = {
     "Llama-3-8B": "meta-llama/Meta-Llama-3-8B",
     "TinyLlama-1.1B": "TinyLlama/TinyLlama-1.1B-Chat-v1.0",
     "Mixtral-8x7B": "mistralai/Mixtral-8x7B-v0.1",
+    "Qwen2-0.5B": "Qwen/Qwen2-0.5B",
+    "Qwen2-1.5B": "Qwen/Qwen2-1.5B",
+    "Qwen2-7B": "Qwen/Qwen2-7B",
     "debug-tiny": "picotron-tpu/debug-tiny",
+    "debug-tiny-qwen": "picotron-tpu/debug-tiny-qwen",
     "debug-tiny-moe": "picotron-tpu/debug-tiny-moe",
 }
 
@@ -201,6 +232,12 @@ class ModelConfig:
     max_position_embeddings: int = 2048
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-5
+    # Qwen2-style architecture variants: bias on the q/k/v projections, and
+    # an LM head tied to the embedding matrix (logits = h @ embedding.T; no
+    # separate lm_head parameter — the Llama family unties, ref:
+    # checkpoint.py:88-91 force-creates lm_head).
+    attention_bias: bool = False
+    tie_word_embeddings: bool = False
     dtype: str = "bfloat16"  # compute/activation dtype; master params are fp32
     # Attention implementation: "auto" picks flash on TPU / reference on CPU;
     # CP > 1 always routes through the ring (ref: model.py:148-158 dispatch).
@@ -565,11 +602,16 @@ def save_config(cfg: Config, path: str) -> None:
         json.dump(cfg.to_json_dict(), f, indent=2)
 
 
-def num_params(m: ModelConfig, active_only: bool = False) -> int:
+def num_params(m: ModelConfig, active_only: bool = False,
+               include_tied_head: bool = False) -> int:
     """Total parameter count (embedding + untied head counted separately,
     matching the reference's accounting in utils.py:50-79). For MoE,
     `active_only` counts the top-k experts a token actually visits — the N
-    that belongs in the 6N FLOPs/token formula."""
+    that belongs in the 6N FLOPs/token formula. `include_tied_head` counts
+    the h*v head term even when tie_word_embeddings shares it with the
+    embedding: the head MATMUL executes either way, so the FLOPs accounting
+    (utils.flops_per_token) must include it or tied models would
+    understate MFU by the head's share."""
     h, i, v, l = m.hidden_size, m.intermediate_size, m.vocab_size, m.num_hidden_layers
     kv = m.num_key_value_heads * m.head_dim
     if m.num_experts:
@@ -586,4 +628,8 @@ def num_params(m: ModelConfig, active_only: bool = False) -> int:
         + ffn
         + 2 * h  # two RMSNorm weights
     )
-    return v * h + l * per_layer + h + h * v  # embed + layers + final_norm + head
+    if m.attention_bias:
+        per_layer += h + 2 * kv  # q/k/v biases
+    head = (h * v if (not m.tie_word_embeddings or include_tied_head)
+            else 0)
+    return v * h + l * per_layer + h + head  # embed + layers + final_norm (+ head)

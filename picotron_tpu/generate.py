@@ -36,7 +36,7 @@ from jax import lax
 from picotron_tpu.config import ModelConfig
 from picotron_tpu.models.llama import (
     DEFAULT_CTX, _mlp_block, _moe_block, compute_dtype, final_hidden,
-    rms_norm,
+    head_weight, qkv_proj, rms_norm,
 )
 from picotron_tpu.ops.rope import apply_rope, rope_tables
 
@@ -87,9 +87,7 @@ def _decode_layers(params, x, cache: KVCache, q_pos, cfg: ModelConfig,
         lp, ck_l, cv_l = inputs
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         b, s, _ = h.shape
-        q = (h @ lp["q"].astype(dt)).reshape(b, s, -1, d)
-        k = (h @ lp["k"].astype(dt)).reshape(b, s, -1, d)
-        v = (h @ lp["v"].astype(dt)).reshape(b, s, -1, d)
+        q, k, v = qkv_proj(h, lp, d)
         q = apply_rope(q, cos, sin, q_pos)
         k = apply_rope(k, cos, sin, q_pos)
         ck_l = lax.dynamic_update_slice(ck_l, k, (0, start, 0, 0))
@@ -110,7 +108,7 @@ def _decode_layers(params, x, cache: KVCache, q_pos, cfg: ModelConfig,
 def _logits_last(params, x, cfg: ModelConfig):
     """Logits of the LAST position only: [B, V] fp32."""
     hf = final_hidden(params, x[:, -1:], cfg)
-    return (hf @ params["lm_head"].astype(hf.dtype))[:, 0].astype(jnp.float32)
+    return (hf @ head_weight(params).astype(hf.dtype))[:, 0].astype(jnp.float32)
 
 
 def _sample(logits, temperature: float, top_k: int, key):

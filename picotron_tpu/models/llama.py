@@ -157,6 +157,13 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         "o": stacked(keys[4], q_out, (q_out, h)),
         "post_norm": jnp.ones((nl, h), jnp.float32),
     }
+    if cfg.attention_bias:
+        # Qwen2-style qkv bias (zero-init, the HF convention)
+        layers.update({
+            "b_q": jnp.zeros((nl, q_out), jnp.float32),
+            "b_k": jnp.zeros((nl, kv_out), jnp.float32),
+            "b_v": jnp.zeros((nl, kv_out), jnp.float32),
+        })
     if cfg.num_experts:
         e, f = cfg.num_experts, cfg.expert_ffn_size
         layers.update({
@@ -173,12 +180,24 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
             "down": stacked(keys[7], i, (i, h)),
         })
 
-    return {
+    params = {
         "embedding": jax.random.normal(keys[0], (v, h), jnp.float32),
         "layers": layers,
         "final_norm": jnp.ones((h,), jnp.float32),
-        "lm_head": _uniform_fan_in(keys[8], h, (h, v)),
     }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = _uniform_fan_in(keys[8], h, (h, v))
+    return params
+
+
+def head_weight(params: Params) -> jnp.ndarray:
+    # The LM-head matrix [H, V(/tp)]: the separate lm_head when the model
+    # unties (the Llama family), else the transposed embedding (Qwen2-style
+    # tying; gradients flow to the embedding through both uses, and under
+    # TP the vocab-sharded [V/tp, H] embedding shard transposes to exactly
+    # the head's [H, V/tp] layout).
+    w = params.get("lm_head")
+    return w if w is not None else params["embedding"].T
 
 
 def param_count(params: Params) -> int:
@@ -257,6 +276,25 @@ def embed(params: Params, input_ids: jnp.ndarray, cfg: ModelConfig,
     return x.astype(compute_dtype(cfg))
 
 
+def qkv_proj(h, lp, d: int):
+    """Shared q/k/v projection (+ optional Qwen2 bias, tp-sharded with its
+    output features) -> ([B,S,Hq,D], [B,S,Hkv,D], [B,S,Hkv,D]); local head
+    counts come from the (possibly TP-sharded) weight shapes. One
+    implementation for the training block AND the KV-cache decode path
+    (generate.py) so attention-input changes cannot silently diverge."""
+    dt = h.dtype
+    b, s, _ = h.shape
+    q = h @ lp["q"].astype(dt)
+    k = h @ lp["k"].astype(dt)
+    v = h @ lp["v"].astype(dt)
+    if "b_q" in lp:
+        q = q + lp["b_q"].astype(dt)
+        k = k + lp["b_k"].astype(dt)
+        v = v + lp["b_v"].astype(dt)
+    return (q.reshape(b, s, -1, d), k.reshape(b, s, -1, d),
+            v.reshape(b, s, -1, d))
+
+
 def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
     """RMSNorm -> qkv -> RoPE -> attention -> out_proj (ref: model.py:122-162)."""
     dt = x.dtype
@@ -266,16 +304,8 @@ def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
     h = ctx.f(h)  # column-parallel entry: identity fwd / psum bwd; under
     # sequence parallelism an all_gather that restores the full sequence
     b, s, _ = h.shape
-    q = h @ lp["q"].astype(dt)
-    k = h @ lp["k"].astype(dt)
-    v = h @ lp["v"].astype(dt)
-
-    # local head counts come from the (possibly TP-sharded) weight shapes
-    n_q = q.shape[-1] // d
-    n_kv = k.shape[-1] // d
-    q = q.reshape(b, s, n_q, d)
-    k = k.reshape(b, s, n_kv, d)
-    v = v.reshape(b, s, n_kv, d)
+    q, k, v = qkv_proj(h, lp, d)
+    n_q = q.shape[2]
 
     # K/V stay unexpanded (n_kv heads) — attention impls handle GQA so the
     # CP ring permutes and flash streams the small K/V. RoPE is applied by
@@ -394,7 +424,7 @@ def logits_from_hidden(params: Params, x: jnp.ndarray, cfg: ModelConfig,
     # entry hook re-gathers the sequence before the vocab-sharded head
     # (identity on every other path).
     x = ctx.f(x)
-    logits = x @ params["lm_head"].astype(x.dtype)
+    logits = x @ head_weight(params).astype(x.dtype)
     return ctx.gather_logits(logits)
 
 
@@ -436,9 +466,9 @@ def loss_sum_count(params: Params, input_ids: jnp.ndarray, targets: jnp.ndarray,
     x, aux = run_layers(params["layers"], x, cfg, ctx, cos, sin)
     x = final_hidden(params, x, cfg)
     if ctx.head_ce is not None:
-        total, count = ctx.head_ce(x, params["lm_head"], targets)
+        total, count = ctx.head_ce(x, head_weight(params), targets)
     else:
-        logits = x @ params["lm_head"].astype(x.dtype)
+        logits = x @ head_weight(params).astype(x.dtype)
         total, count = cross_entropy_sum_count(logits, targets)
     extras = {}
     if cfg.num_experts:

@@ -52,8 +52,8 @@ from jax import lax
 
 from picotron_tpu.config import Config
 from picotron_tpu.models.llama import (
-    ParallelCtx, compute_dtype, embed, final_hidden, remat_policy_for,
-    run_layers,
+    ParallelCtx, compute_dtype, embed, final_hidden, head_weight,
+    remat_policy_for, run_layers,
 )
 from picotron_tpu.ops.losses import IGNORE_INDEX, cross_entropy_sum_count
 from picotron_tpu.ops.rope import rope_tables
@@ -140,14 +140,17 @@ def _make_stage_fn(ids, tgt, m, ctx: ParallelCtx, cos, sin, s_idx, pp):
         #    pvary there implicitly, whose transpose is again an in-branch
         #    psum — so promote them out here, where the psum is uniform.
         y_vma = set(jax.typeof(y).vma)
-        head_v = _vary_over(params["lm_head"], y_vma)
+        # the head weight source is lm_head, or the embedding when tied
+        # (Qwen2-style) — promote whichever the scoring branch will read
+        head_key = "lm_head" if "lm_head" in params else "embedding"
+        head_v = _vary_over(params[head_key], y_vma)
         norm_v = _vary_over(params["final_norm"], y_vma)
-        params_v = {**params, "lm_head": head_v, "final_norm": norm_v}
+        params_v = {**params, head_key: head_v, "final_norm": norm_v}
 
         def _anchor(args):
             y_sc, params_sc = args
             return (y_sc.ravel()[0].astype(jnp.float32)
-                    + params_sc["lm_head"].ravel()[0].astype(jnp.float32)) * 0.0
+                    + params_sc[head_key].ravel()[0].astype(jnp.float32)) * 0.0
 
         if gated:
             # neutral branch merges to logz = log(tp_size) — finite garbage
@@ -157,7 +160,7 @@ def _make_stage_fn(ids, tgt, m, ctx: ParallelCtx, cos, sin, s_idx, pp):
             def score(args):
                 y_sc, params_sc = args
                 hf = final_hidden(params_sc, y_sc, m)
-                return ctx.head_ce_local(hf, params_sc["lm_head"], mb_tgt)
+                return ctx.head_ce_local(hf, head_weight(params_sc), mb_tgt)
 
             def no_score(args):
                 a = _anchor(args)
@@ -168,7 +171,7 @@ def _make_stage_fn(ids, tgt, m, ctx: ParallelCtx, cos, sin, s_idx, pp):
             total = ctx.head_ce_merge(stats, mb_tgt)
         elif ctx.head_ce is not None:
             hf = final_hidden(params, y, m)
-            total, _ = ctx.head_ce(hf, params["lm_head"], mb_tgt)
+            total, _ = ctx.head_ce(hf, head_weight(params), mb_tgt)
         else:
             # no TP head hook (plain unsharded head): the whole scoring is
             # already collective-free, so the cond can return the total
@@ -176,7 +179,7 @@ def _make_stage_fn(ids, tgt, m, ctx: ParallelCtx, cos, sin, s_idx, pp):
             def score_full(args):
                 y_sc, params_sc = args
                 hf = final_hidden(params_sc, y_sc, m)
-                logits = hf @ params_sc["lm_head"].astype(hf.dtype)
+                logits = hf @ head_weight(params_sc).astype(hf.dtype)
                 total, _ = cross_entropy_sum_count(logits, mb_tgt)
                 return total
 

@@ -40,6 +40,13 @@ def param_specs(cfg: Config) -> dict[str, Any]:
         "o": P(pp, "tp", None),
         "post_norm": P(pp, None),
     }
+    if cfg.model.attention_bias:
+        # qkv biases shard over tp with their output features
+        layers.update({
+            "b_q": P(pp, "tp"),
+            "b_k": P(pp, "tp"),
+            "b_v": P(pp, "tp"),
+        })
     if cfg.model.num_experts:
         # expert banks [L, E, ...]: expert dim over 'ep', ffn dim over 'tp'
         # (column-parallel gate/up, row-parallel down — same as the dense
@@ -56,12 +63,14 @@ def param_specs(cfg: Config) -> dict[str, Any]:
             "up": P(pp, None, "tp"),
             "down": P(pp, "tp", None),
         })
-    return {
+    specs = {
         "embedding": P("tp", None),
         "layers": layers,
         "final_norm": P(),
-        "lm_head": P(None, "tp"),
     }
+    if not cfg.model.tie_word_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
 
 
 def batch_spec() -> P:

@@ -69,7 +69,8 @@ def flops_per_token(m: ModelConfig, seq_length: int) -> float:
     """Training FLOPs per token: 6N + 12·L·h·s — same formula the reference
     uses so MFU numbers are directly comparable (ref: utils.py:46-47).
     """
-    n = num_params(m, active_only=True)  # MoE: only visited experts compute
+    # MoE: only visited experts compute; tied head: the matmul runs anyway
+    n = num_params(m, active_only=True, include_tied_head=True)
     return 6.0 * n + 12.0 * m.num_hidden_layers * m.hidden_size * seq_length
 
 

@@ -191,3 +191,32 @@ def test_tied_head_checkpoint_unties(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(loaded["lm_head"]),
         np.asarray(loaded["embedding"]).T)
+
+
+def test_hf_safetensors_roundtrip_qwen2_bias_tied(tmp_path):
+    """Qwen2-style checkpoints: qkv biases map to b_q/b_k/b_v (no
+    transpose), and a tied config materializes NO lm_head param — while the
+    same file loaded as an untied config unties by copying the embedding."""
+    import dataclasses
+
+    from picotron_tpu.config import resolve_preset
+    from picotron_tpu.models.llama import head_weight, init_params
+
+    cfg = ModelConfig(dtype="float32", **resolve_preset("debug-tiny-qwen"))
+    params = init_params(cfg, jax.random.key(3))
+    # make the biases non-trivial so the roundtrip actually checks them
+    params["layers"]["b_q"] = jax.random.normal(
+        jax.random.key(4), params["layers"]["b_q"].shape)
+    save_hf_safetensors(params, str(tmp_path / "hf"))
+
+    back = load_hf_safetensors(str(tmp_path / "hf"), cfg)
+    assert "lm_head" not in back
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=1e-6),
+        params, back)
+
+    untied = dataclasses.replace(cfg, tie_word_embeddings=False)
+    back2 = load_hf_safetensors(str(tmp_path / "hf"), untied)
+    np.testing.assert_allclose(np.asarray(back2["lm_head"]),
+                               np.asarray(head_weight(params)), rtol=1e-6)

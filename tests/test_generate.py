@@ -124,3 +124,18 @@ def test_cache_shapes(tiny):
     cache = init_cache(cfg, batch=2, max_length=16)
     assert cache.k.shape == (cfg.num_hidden_layers, 2, 16,
                              cfg.num_key_value_heads, cfg.head_dim)
+
+
+def test_cache_decode_matches_forward_qwen2_bias_tied():
+    cfg = ModelConfig(dtype="float32", **{
+        **resolve_preset("debug-tiny-qwen"), "max_position_embeddings": 64})
+    params = init_params(cfg, jax.random.key(0))
+    # non-zero biases, or a decode path that silently drops them would pass
+    for b in ("b_q", "b_k", "b_v"):
+        params["layers"][b] = 0.1 * jax.random.normal(
+            jax.random.key(hash(b) % 1000), params["layers"][b].shape)
+    ids = jax.random.randint(jax.random.key(1), (2, 10), 0, cfg.vocab_size)
+    want = forward(params, cfg=cfg, input_ids=ids).astype(jnp.float32)
+    got = teacher_forced_cache_logits(params, cfg, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)

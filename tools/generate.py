@@ -63,37 +63,42 @@ def main() -> None:
             ap.error("--ckpt-dir needs --config <json>")
         cfg: Config = load_config(args.config)
         cfg_m = cfg.model
+        import orbax.checkpoint as ocp
+
         from picotron_tpu.checkpoint import CheckpointManager
         from picotron_tpu.mesh import MeshEnv
-        from picotron_tpu.models.llama import pad_layers_for_pp, unpad_layers
-        from picotron_tpu.parallel.api import init_sharded_state
-        from picotron_tpu.train_step import TrainState
+        from picotron_tpu.models.llama import (
+            init_params, pad_layers_for_pp, unpad_layers,
+        )
 
         menv = MeshEnv.create(dp=1, devices=jax.devices()[:1])
-        single = Config(model=cfg.model, training=cfg.training)
-        template = init_sharded_state(single, menv, jax.random.key(0))
-        # Checkpoints store the PP-padded layer stack of the training run's
-        # pp_size — the restore template (params AND the param-shaped Adam
-        # moment subtrees) must match that shape; the canonical [L] stack
-        # is gathered back out for decoding.
-        nl, pp = cfg_m.num_hidden_layers, cfg.distributed.pp_size
-        params_treedef = jax.tree.structure(template.params)
-
-        def pad_sub(sub):
-            if jax.tree.structure(sub) == params_treedef:
-                return pad_layers_for_pp(sub, nl, pp)
-            return sub
-
-        opt_padded = jax.tree.map(
-            pad_sub, template.opt_state,
-            is_leaf=lambda x: jax.tree.structure(x) == params_treedef)
-        template = TrainState(
-            params=pad_layers_for_pp(template.params, nl, pp),
-            opt_state=opt_padded, step=template.step)
         mgr = CheckpointManager(cfg, menv, directory=args.ckpt_dir)
-        state, _ = mgr.restore(template)
-        params = unpad_layers(state.params, cfg_m.num_hidden_layers,
-                              cfg.distributed.pp_size)
+        step_n = mgr.latest_step()
+        if step_n is None:
+            ap.error(f"no checkpoints under {args.ckpt_dir}")
+        # Params-only restore: decode needs no Adam moments, and restoring
+        # them would cost ~3x the IO and ~3x the host memory of the params
+        # (an OOM at 7B scale). ocp.PLACEHOLDER skips the opt_state/step
+        # entries entirely. The template carries the training run's
+        # PP-padded layer-stack shapes; the canonical [L] stack is gathered
+        # back out for decoding.
+        nl, pp = cfg_m.num_hidden_layers, cfg.distributed.pp_size
+        abstract = jax.eval_shape(
+            lambda: pad_layers_for_pp(init_params(cfg_m, jax.random.key(0)),
+                                      nl, pp))
+        path = f"{mgr.directory}/step_{step_n:08d}/state"
+        sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        restore_args = jax.tree.map(
+            lambda x: ocp.ArrayRestoreArgs(dtype=x.dtype, sharding=sharding),
+            abstract)
+        with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
+            restored = ckptr.restore(
+                path,
+                args=ocp.args.PyTreeRestore(
+                    item={"params": abstract},
+                    restore_args={"params": restore_args},
+                    partial_restore=True))
+        params = unpad_layers(restored["params"], nl, pp)
 
     tokenizer = None
     if args.prompt is not None:
