@@ -316,6 +316,12 @@ class TrainingConfig:
     gradient_accumulation_steps: int = 1
     num_samples: Optional[int] = None
     max_tokens: Optional[int] = None
+    # Periodic validation: every eval_frequency training steps, run
+    # eval_steps batches from the eval source (dataset.eval_split for HF
+    # datasets; a disjoint-seed synthetic stream otherwise) and log
+    # val_loss. 0 disables (the reference has no eval loop).
+    eval_frequency: int = 0
+    eval_steps: int = 8
     # Stream the LM-head cross-entropy over vocab chunks of this many
     # columns: the [tokens, vocab] logits never materialize (neither as a
     # forward tensor nor a saved backward residual — chunks recompute),
@@ -342,6 +348,9 @@ class DatasetConfig:
     num_workers: int = 0
     num_proc: int = 1
     split: str = "train"
+    # HF split for the validation loader (training.eval_frequency > 0);
+    # None with a synthetic source uses a disjoint seed stream.
+    eval_split: Optional[str] = None
     text_column: str = "text"
 
 
@@ -521,6 +530,10 @@ class Config:
         # is padded with identity (all-zero) layers and the remainder goes to
         # early stages (ref: pipeline_parallel.py:42-51 distribute_layers);
         # see models.llama.pp_layer_placement.
+        if t.eval_frequency < 0 or (t.eval_frequency > 0 and t.eval_steps < 1):
+            raise ValueError(
+                "eval_frequency must be >= 0 and eval_steps >= 1 when "
+                f"eval is enabled, got {t.eval_frequency}/{t.eval_steps}")
         if t.gradient_accumulation_steps < 1:
             raise ValueError(
                 f"gradient_accumulation_steps must be >= 1, got "

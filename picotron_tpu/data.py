@@ -169,6 +169,32 @@ class DatasetSource:
         return np.asarray(rows, dtype=np.int32)
 
 
+def build_eval_source(cfg: Config):
+    """Validation batch source (training.eval_frequency > 0): the HF
+    dataset's `eval_split` when configured, else a synthetic stream on a
+    seed offset disjoint from training's."""
+    d = cfg.dataset
+    if d.name == "synthetic":
+        return SyntheticSource(
+            cfg.model.vocab_size, cfg.training.seq_length,
+            seed=cfg.training.seed + 104729,  # disjoint PRNG stream
+            num_samples=cfg.training.num_samples,
+        )
+    if d.eval_split is None:
+        raise ValueError(
+            "training.eval_frequency > 0 with an HF dataset requires "
+            "dataset.eval_split (e.g. 'validation')")
+    import datasets
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(
+        d.tokenizer_name or cfg.model.name)
+    raw = datasets.load_dataset(d.name, d.subset_name, split=d.eval_split)
+    chunked = tokenize_and_chunk(raw, tokenizer, cfg.training.seq_length,
+                                 d.text_column, d.num_proc)
+    return DatasetSource(chunked, shuffle_seed=None)
+
+
 # ---------------------------------------------------------------------------
 # The loader
 # ---------------------------------------------------------------------------

@@ -329,6 +329,44 @@ def make_train_step(cfg: Config, menv: MeshEnv):
     return step
 
 
+def make_eval_step(cfg: Config, menv: MeshEnv):
+    """Jitted forward-only (params, batch) -> loss over the mesh — the
+    validation half of the train step: same sharded loss computation
+    (pipeline engines included, via the AFAB loss path), no grads, no
+    optimizer, no donation (params are reused across eval batches)."""
+    cfg.validate()
+    pspecs = param_specs(cfg)
+    bspec = batch_spec()
+
+    def _device_loss(params, batch):
+        ctx = make_parallel_ctx(cfg)
+        ids, tgt = batch
+        if cfg.distributed.pp_size > 1:
+            from picotron_tpu.parallel.pp import pipeline_loss_sum_count
+
+            total, count, _ = pipeline_loss_sum_count(params, ids, tgt,
+                                                      cfg, ctx)
+        else:
+            def body(carry, mb):
+                l_acc, c_acc = carry
+                total, count, _ = loss_sum_count(params, mb[0], mb[1],
+                                                 cfg.model, ctx)
+                return (l_acc + total, c_acc + count), None
+
+            init = lax.pcast(
+                (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+                ("dp", "ep", "cp"), to="varying")
+            (total, count), _ = lax.scan(body, init, (ids, tgt))
+        total = lax.psum(total, ("dp", "ep", "cp"))
+        count = jnp.maximum(lax.psum(count, ("dp", "ep", "cp")), 1)
+        return total / count
+
+    loss_fn_sharded = jax.shard_map(
+        _device_loss, mesh=menv.mesh,
+        in_specs=(pspecs, (bspec, bspec)), out_specs=P())
+    return jax.jit(loss_fn_sharded)
+
+
 def init_sharded_state(cfg: Config, menv: MeshEnv, key: jax.Array) -> TrainState:
     """Initialize params directly into their mesh shardings (each device
     materializes only its shard — the role of the reference's meta-device

@@ -134,6 +134,20 @@ def main(argv=None) -> None:
             }
         dl.set_state(dl_state)
     step_fn = make_train_step(cfg, menv)
+    eval_batches = eval_fn = None
+    if t.eval_frequency > 0:
+        from picotron_tpu.data import build_eval_source
+        from picotron_tpu.parallel.api import make_eval_step
+
+        # Materialize a FIXED validation set once: every eval (and every
+        # resumed run) scores the same batches, so the val_loss curve
+        # reflects the model, not which slice of the split got sampled
+        # (code review r3).
+        eval_dl = MicroBatchDataLoader(cfg, menv,
+                                       source=build_eval_source(cfg))
+        eval_batches = [next(eval_dl) for _ in range(t.eval_steps)]
+        eval_dl.close()
+        eval_fn = make_eval_step(cfg, menv)
     ckpt_mgr = (CheckpointManager(cfg, menv)
                 if cfg.checkpoint.save_frequency > 0 else None)
 
@@ -201,6 +215,15 @@ def main(argv=None) -> None:
                                "mfu": mfu_frac,
                                "trained_tokens": trained_tokens, **metrics},
                               step=step)
+
+        if eval_fn is not None and (step % t.eval_frequency == 0
+                                    or step == total_steps):
+            val = sum(float(eval_fn(state.params, b))
+                      for b in eval_batches) / len(eval_batches)
+            log_print(f"[eval  {step:06d}] val_loss: {val:.4f} "
+                      f"({t.eval_steps} batches)")
+            if wandb_run is not None:
+                wandb_run.log({"val_loss": val}, step=step)
 
         if ckpt_mgr is not None and step % cfg.checkpoint.save_frequency == 0:
             path = ckpt_mgr.save(state, trained_tokens,

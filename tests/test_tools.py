@@ -94,3 +94,42 @@ def test_job_status_machine(tmp_path):
     (run / "train.log").write_text("some other crash")
     assert job.classify(returncode=1) == "fail"
     assert job.classify(returncode=0) == "completed"
+
+
+def test_extract_metrics_harvests_extras_and_val_loss(tmp_path):
+    """The harvester picks up trailing extras (moe_drop_frac) and dedicated
+    eval lines from the de-facto log-line API."""
+    import sys
+
+    sys.path.insert(0, "tools")
+    from extract_metrics import process_file
+
+    log = tmp_path / "train.log"
+    lines = []
+    for s in range(1, 7):
+        lines.append(
+            f"[step {s:06d}] loss: 5.{s}000 | tokens/s: 1.5K | "
+            f"tokens/s/chip: 750 | MFU: 45.00% | tokens: 10K | "
+            f"mem: 1.0GB | moe_drop_frac: 0.0{s}00")
+    lines.append("[eval  000004] val_loss: 5.4321 (8 batches)")
+    lines.append("[eval  000006] val_loss: 5.2100 (8 batches)")
+    log.write_text("\n".join(lines))
+    out = process_file(str(log))
+    assert abs(out["mean_moe_drop_frac"] - 0.05) < 1e-9  # steps 4..6
+    assert out["final_val_loss"] == 5.21
+
+
+def test_extract_metrics_extras_skip_stable_suffixed_fields(tmp_path):
+    import sys
+
+    sys.path.insert(0, "tools")
+    from extract_metrics import process_file
+
+    log = tmp_path / "train.log"
+    log.write_text(
+        "[step 000004] loss: 5.0000 | tokens/s: 1.5K | tokens/s/chip: 750 "
+        "| MFU: 45.00% | tokens: 10K | mem: 1.0GB\n"
+        "[step 000005] loss: 5.0000 | tokens/s: 1.5K | tokens/s/chip: 750 "
+        "| MFU: 45.00% | tokens: 20K | mem: 1.0GB\n")
+    out = process_file(str(log))
+    assert "mean_tokens" not in out and "mean_mem" not in out

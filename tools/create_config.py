@@ -61,6 +61,11 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["constant", "cosine", "linear"])
     p.add_argument("--lr-warmup-steps", type=int, default=0)
     p.add_argument("--total-train-steps", type=int, default=200)
+    p.add_argument("--eval-frequency", type=int, default=0,
+                   help="run a val-loss pass every N steps (0 = off); HF "
+                        "datasets need --eval-split")
+    p.add_argument("--eval-steps", type=int, default=8)
+    p.add_argument("--eval-split", default=None)
     p.add_argument("--no-remat", action="store_true")
     # dataset
     p.add_argument("--dataset", default="synthetic")
@@ -120,11 +125,14 @@ def create_single_config(args) -> str:
             "lr_schedule": args.lr_schedule,
             "lr_warmup_steps": args.lr_warmup_steps,
             "total_train_steps": args.total_train_steps,
+            "eval_frequency": args.eval_frequency,
+            "eval_steps": args.eval_steps,
             "remat": not args.no_remat,
         },
         "dataset": {
             "name": args.dataset, "subset_name": args.subset,
-            "split": args.split, "tokenizer_name": args.tokenizer,
+            "split": args.split, "eval_split": args.eval_split,
+            "tokenizer_name": args.tokenizer,
         },
         "checkpoint": {"save_frequency": args.save_frequency,
                        "auto_resume": args.auto_resume},

@@ -30,6 +30,17 @@ LINE_RE = re.compile(
 
 NAME_RE = re.compile(r"(dp|tp|pp|cp)(\d+)")
 
+# Optional trailing step metrics appended by training_log_line's `extras`
+# (e.g. "| moe_drop_frac: 0.0123"): harvested into mean_<key> columns.
+# The value must end the field (lookahead): the stable suffixed fields
+# ("tokens: 10K", "mem: 1.0GB") must NOT be scooped up — their numeric
+# prefix alone would be wrong (suffix dropped) and meaningless to average.
+EXTRA_RE = re.compile(r"\| (?P<key>[a-z_]+): (?P<val>[\d.]+)(?= \||$)")
+_EXTRA_SKIP = {"tokens", "mem"}
+
+# Dedicated eval lines ("[eval  000010] val_loss: 5.6021 (8 batches)").
+EVAL_RE = re.compile(r"\[eval  (?P<step>\d+)\] val_loss: (?P<val>[\d.]+)")
+
 _SUFFIX = {"K": 1e3, "M": 1e6, "B": 1e9, "T": 1e12}
 
 
@@ -50,23 +61,31 @@ def process_file(path: str, skip_steps: int = 3) -> dict | None:
     """Mean tokens/s/chip and MFU over post-warmup steps
     (ref: extract_metrics.py:83-89 skips the first 3 steps)."""
     rows = []
+    val_losses = []
     with open(path) as f:
         for line in f:
             m = LINE_RE.search(line)
             if m:
-                rows.append({
+                row = {
                     "step": int(m.group("step")),
                     "loss": float(m.group("loss")),
                     "tokens_per_sec": parse_human(m.group("tps")),
                     "tokens_per_sec_per_chip": parse_human(m.group("tpsc")),
                     "mfu_pct": float(m.group("mfu")),
-                })
+                }
+                for em in EXTRA_RE.finditer(line[m.end():].rstrip()):
+                    if em.group("key") not in _EXTRA_SKIP:
+                        row["extra_" + em.group("key")] = float(em.group("val"))
+                rows.append(row)
+            ev = EVAL_RE.search(line)
+            if ev:
+                val_losses.append(float(ev.group("val")))
     rows = [r for r in rows if r["step"] > skip_steps]
     if not rows:
         return None
     # A diverged run must be visible in the sweep, not silently dropped —
     # final_loss will read nan/inf.
-    return {
+    out = {
         "steps": len(rows),
         "final_loss": rows[-1]["loss"],
         "mean_tokens_per_sec": mean(r["tokens_per_sec"] for r in rows),
@@ -74,6 +93,13 @@ def process_file(path: str, skip_steps: int = 3) -> dict | None:
             r["tokens_per_sec_per_chip"] for r in rows),
         "mean_mfu_pct": mean(r["mfu_pct"] for r in rows),
     }
+    extra_keys = {k for r in rows for k in r if k.startswith("extra_")}
+    for k in sorted(extra_keys):
+        vals = [r[k] for r in rows if k in r]
+        out["mean_" + k.removeprefix("extra_")] = mean(vals)
+    if val_losses:
+        out["final_val_loss"] = val_losses[-1]
+    return out
 
 
 def find_log(run_dir: str) -> str | None:
