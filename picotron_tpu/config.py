@@ -63,6 +63,34 @@ MODEL_PRESETS: dict[str, dict[str, Any]] = {
         num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
         max_position_embeddings=8192, rope_theta=500000.0, rms_norm_eps=1e-5,
     ),
+    # Llama-3.1/3.2 (llama3-type RoPE scaling for 128k context)
+    "meta-llama/Llama-3.1-8B": dict(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+        max_position_embeddings=131072, rope_theta=500000.0,
+        rms_norm_eps=1e-5,
+        rope_scaling=dict(rope_type="llama3", factor=8.0,
+                          low_freq_factor=1.0, high_freq_factor=4.0,
+                          original_max_position_embeddings=8192),
+    ),
+    "meta-llama/Llama-3.2-1B": dict(
+        vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+        num_hidden_layers=16, num_attention_heads=32, num_key_value_heads=8,
+        max_position_embeddings=131072, rope_theta=500000.0,
+        rms_norm_eps=1e-5, tie_word_embeddings=True,
+        rope_scaling=dict(rope_type="llama3", factor=32.0,
+                          low_freq_factor=1.0, high_freq_factor=4.0,
+                          original_max_position_embeddings=8192),
+    ),
+    "meta-llama/Llama-3.2-3B": dict(
+        vocab_size=128256, hidden_size=3072, intermediate_size=8192,
+        num_hidden_layers=28, num_attention_heads=24, num_key_value_heads=8,
+        max_position_embeddings=131072, rope_theta=500000.0,
+        rms_norm_eps=1e-5, tie_word_embeddings=True,
+        rope_scaling=dict(rope_type="llama3", factor=32.0,
+                          low_freq_factor=1.0, high_freq_factor=4.0,
+                          original_max_position_embeddings=8192),
+    ),
     # TinyLlama
     "TinyLlama/TinyLlama-1.1B-Chat-v1.0": dict(
         vocab_size=32000, hidden_size=2048, intermediate_size=5632,
@@ -128,6 +156,9 @@ _PRESET_ALIASES = {
     "Llama-2-7B": "meta-llama/Llama-2-7b-hf",
     "Llama-2-13B": "meta-llama/Llama-2-13b-hf",
     "Llama-3-8B": "meta-llama/Meta-Llama-3-8B",
+    "Llama-3.1-8B": "meta-llama/Llama-3.1-8B",
+    "Llama-3.2-1B": "meta-llama/Llama-3.2-1B",
+    "Llama-3.2-3B": "meta-llama/Llama-3.2-3B",
     "TinyLlama-1.1B": "TinyLlama/TinyLlama-1.1B-Chat-v1.0",
     "Mixtral-8x7B": "mistralai/Mixtral-8x7B-v0.1",
     "Qwen2-0.5B": "Qwen/Qwen2-0.5B",
@@ -231,6 +262,12 @@ class ModelConfig:
     num_key_value_heads: int = 2
     max_position_embeddings: int = 2048
     rope_theta: float = 10000.0
+    # HF-style rope_scaling config (Llama-3.1/3.2's {"rope_type": "llama3",
+    # "factor": 8.0, ...} or {"rope_type": "linear", "factor": N}); None =
+    # unscaled. Stored internally as a sorted (key, value) tuple so the
+    # frozen config stays hashable (generation jits with the config as a
+    # static argument); pass a plain dict, __post_init__ normalizes.
+    rope_scaling: Optional[Any] = None
     rms_norm_eps: float = 1e-5
     # Qwen2-style architecture variants: bias on the q/k/v projections, and
     # an LM head tied to the embedding matrix (logits = h @ embedding.T; no
@@ -264,6 +301,21 @@ class ModelConfig:
     # Accepted for reference compat (ref uses them to pick CUDA kernels).
     use_flash_attention: bool = True
     use_fused_adam: bool = True
+
+    def __post_init__(self):
+        rs = self.rope_scaling
+        if isinstance(rs, dict):
+            rs = tuple(sorted(rs.items()))
+        elif isinstance(rs, (list, tuple)) and rs:
+            # JSON round-trip (to_json_dict -> config_from_dict) turns the
+            # tuple of pairs into nested lists — re-normalize so the frozen
+            # config stays hashable
+            rs = tuple(sorted(tuple(pair) for pair in rs))
+        object.__setattr__(self, "rope_scaling", rs or None)
+
+    @property
+    def rope_scaling_dict(self) -> Optional[dict]:
+        return dict(self.rope_scaling) if self.rope_scaling else None
 
     @property
     def head_dim(self) -> int:

@@ -36,9 +36,9 @@ from jax import lax
 from picotron_tpu.config import ModelConfig
 from picotron_tpu.models.llama import (
     DEFAULT_CTX, _mlp_block, _moe_block, compute_dtype, final_hidden,
-    head_weight, qkv_proj, rms_norm,
+    head_weight, model_rope_tables, qkv_proj, rms_norm,
 )
-from picotron_tpu.ops.rope import apply_rope, rope_tables
+from picotron_tpu.ops.rope import apply_rope
 
 
 class KVCache(NamedTuple):
@@ -128,8 +128,10 @@ def _generate_jit(params, prompt_ids, cfg: ModelConfig,
                   eos_token_id: Optional[int], key):
     b, p_len = prompt_ids.shape
     max_len = p_len + max_new_tokens
-    cos, sin = rope_tables(max(cfg.max_position_embeddings, max_len),
-                           cfg.head_dim, cfg.rope_theta)
+    # Tables sized to the positions actually indexed (max_len), not the
+    # preset's max_position_embeddings — Llama-3.1's 131072-position limit
+    # would bake ~64 MB of cos/sin constants into every compiled variant.
+    cos, sin = model_rope_tables(cfg, max_len=max_len)
     cache = init_cache(cfg, b, max_len)
 
     # prefill: one batched pass over the prompt

@@ -41,6 +41,14 @@ from picotron_tpu.ops.losses import cross_entropy, cross_entropy_sum_count
 from picotron_tpu.ops.rmsnorm import rms_norm
 from picotron_tpu.ops.rope import apply_rope, rope_tables
 
+
+def model_rope_tables(cfg, max_len=None):
+    """RoPE tables for a model config, honoring cfg.rope_scaling
+    (Llama-3.1/3.2). All model-level paths must build tables through this
+    helper so scaling cannot be silently dropped on one path."""
+    return rope_tables(max_len or cfg.max_position_embeddings, cfg.head_dim,
+                       cfg.rope_theta, rope_scaling=cfg.rope_scaling_dict)
+
 Params = dict[str, Any]
 
 
@@ -399,8 +407,7 @@ def run_layers(layer_params: Params, x: jnp.ndarray, cfg: ModelConfig,
     over the scanned layers, aux[1] the summed capacity drop fraction
     (both 0 for dense models)."""
     if cos is None:
-        cos, sin = rope_tables(cfg.max_position_embeddings, cfg.head_dim,
-                               cfg.rope_theta)
+        cos, sin = model_rope_tables(cfg)
 
     def body(h, lp):
         h, aux = decoder_layer(h, lp, cfg, ctx, cos, sin)
@@ -436,7 +443,7 @@ def logits_from_hidden(params: Params, x: jnp.ndarray, cfg: ModelConfig,
 def forward(params: Params, input_ids: jnp.ndarray, cfg: ModelConfig,
             ctx: ParallelCtx = DEFAULT_CTX) -> jnp.ndarray:
     """input_ids [B, S] -> logits [B, S, V] (full vocab; eval/debug path)."""
-    cos, sin = rope_tables(cfg.max_position_embeddings, cfg.head_dim, cfg.rope_theta)
+    cos, sin = model_rope_tables(cfg)
     x = embed(params, input_ids, cfg, ctx)
     x, _ = run_layers(params["layers"], x, cfg, ctx, cos, sin)
     x = final_hidden(params, x, cfg)
@@ -461,7 +468,7 @@ def loss_sum_count(params: Params, input_ids: jnp.ndarray, targets: jnp.ndarray,
     token-weighted observability sums ({"moe_drop_weighted"} for MoE, {}
     for dense) that ride the same psum path; the step normalizes them.
     """
-    cos, sin = rope_tables(cfg.max_position_embeddings, cfg.head_dim, cfg.rope_theta)
+    cos, sin = model_rope_tables(cfg)
     x = embed(params, input_ids, cfg, ctx)
     x, aux = run_layers(params["layers"], x, cfg, ctx, cos, sin)
     x = final_hidden(params, x, cfg)

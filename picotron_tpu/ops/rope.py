@@ -17,11 +17,56 @@ import jax
 import jax.numpy as jnp
 
 
-def rope_tables(max_seq_len: int, head_dim: int, base: float = 10000.0):
-    """Precompute cos/sin tables, shape [max_seq_len, head_dim // 2], fp32."""
+def llama3_scale_freqs(inv_freq: jnp.ndarray, factor: float = 8.0,
+                       low_freq_factor: float = 1.0,
+                       high_freq_factor: float = 4.0,
+                       original_max_position: int = 8192) -> jnp.ndarray:
+    """Llama-3.1-style RoPE frequency scaling (the `rope_scaling:
+    {"rope_type": "llama3"}` of Llama-3.1/3.2 HF configs): long-wavelength
+    frequencies are divided by `factor` (context extension), short
+    wavelengths are kept, and the band between `high_freq_factor` and
+    `low_freq_factor` wavelengths-per-original-context interpolates
+    smoothly between the two."""
+    wavelen = 2.0 * jnp.pi / inv_freq
+    low_wl = original_max_position / low_freq_factor
+    high_wl = original_max_position / high_freq_factor
+    # smooth factor in [0, 1]: 1 at high-frequency end, 0 at low-frequency
+    smooth = (original_max_position / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor)
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    scaled = jnp.where(
+        wavelen > low_wl, inv_freq / factor,
+        jnp.where(wavelen < high_wl, inv_freq,
+                  (1 - smooth) * inv_freq / factor + smooth * inv_freq))
+    return scaled
+
+
+def rope_tables(max_seq_len: int, head_dim: int, base: float = 10000.0,
+                rope_scaling: dict | None = None):
+    """Precompute cos/sin tables, shape [max_seq_len, head_dim // 2], fp32.
+
+    `rope_scaling`: optional HF-style dict; supported `rope_type`s:
+    "llama3" (Llama-3.1/3.2 frequency banding) and "linear" (positions
+    divided by `factor`)."""
     assert head_dim % 2 == 0, "head_dim must be even for RoPE"
     exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
     inv_freq = 1.0 / (base ** exponent)  # [head_dim/2]
+    if rope_scaling:
+        kind = rope_scaling.get("rope_type", rope_scaling.get("type"))
+        if kind == "llama3":
+            inv_freq = llama3_scale_freqs(
+                inv_freq,
+                factor=rope_scaling.get("factor", 8.0),
+                low_freq_factor=rope_scaling.get("low_freq_factor", 1.0),
+                high_freq_factor=rope_scaling.get("high_freq_factor", 4.0),
+                original_max_position=rope_scaling.get(
+                    "original_max_position_embeddings", 8192))
+        elif kind == "linear":
+            inv_freq = inv_freq / rope_scaling.get("factor", 1.0)
+        else:
+            raise ValueError(
+                f"unsupported rope_scaling type {kind!r} (supported: "
+                f"'llama3', 'linear')")
     positions = jnp.arange(max_seq_len, dtype=jnp.float32)[:, None]  # [S, 1]
     angles = positions * inv_freq[None, :]  # [S, head_dim/2]
     return jnp.cos(angles), jnp.sin(angles)

@@ -367,11 +367,18 @@ def make_eval_step(cfg: Config, menv: MeshEnv):
     return jax.jit(loss_fn_sharded)
 
 
-def init_sharded_state(cfg: Config, menv: MeshEnv, key: jax.Array) -> TrainState:
+def init_sharded_state(cfg: Config, menv: MeshEnv, key: jax.Array,
+                       abstract: bool = False) -> TrainState:
     """Initialize params directly into their mesh shardings (each device
     materializes only its shard — the role of the reference's meta-device
     init + per-rank materialization, ref: checkpoint.py:15-102, minus the
-    safetensors shape-template dance)."""
+    safetensors shape-template dance).
+
+    `abstract=True` returns sharding-annotated ShapeDtypeStructs instead of
+    real arrays — zero memory, same shardings — for AOT uses like
+    tools/memcheck.py's compile-only analysis (materializing a 7B model's
+    fp32 master + moments just to call .lower() would need ~84 GB of host
+    RAM)."""
     cfg.validate()
     mesh = menv.mesh
     shardings = param_shardings(cfg, mesh)
@@ -383,7 +390,12 @@ def init_sharded_state(cfg: Config, menv: MeshEnv, key: jax.Array) -> TrainState
                                  cfg.model.num_hidden_layers,
                                  cfg.distributed.pp_size)
 
-    params = jax.jit(init, out_shardings=shardings)(key)
+    if abstract:
+        params = jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            jax.eval_shape(init, key), shardings)
+    else:
+        params = jax.jit(init, out_shardings=shardings)(key)
     opt = make_optimizer(cfg.training)
     # Optimizer moments must mirror the param shardings (Adam mu/nu live
     # wherever their param lives — the reference gets this implicitly from
@@ -418,8 +430,14 @@ def init_sharded_state(cfg: Config, menv: MeshEnv, key: jax.Array) -> TrainState
     opt_shardings = jax.tree.map(
         opt_subtree_shardings, abstract_opt,
         is_leaf=lambda x: jax.tree.structure(x) == params_treedef)
-    opt_state = jax.jit(opt.init, out_shardings=opt_shardings)(params)
-    step0 = jax.device_put(jnp.zeros((), jnp.int32), replicated)
+    if abstract:
+        opt_state = jax.tree.map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            abstract_opt, opt_shardings)
+        step0 = jax.ShapeDtypeStruct((), jnp.int32, sharding=replicated)
+    else:
+        opt_state = jax.jit(opt.init, out_shardings=opt_shardings)(params)
+        step0 = jax.device_put(jnp.zeros((), jnp.int32), replicated)
     return TrainState(params=params, opt_state=opt_state, step=step0)
 
 

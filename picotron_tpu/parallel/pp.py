@@ -40,6 +40,19 @@ scan carry; live boundary inputs sit in a min(n_micro, 2(pp-1))-slot ring,
 *independent of n_micro* (AFAB's live set grows with n_micro). 1f1b is the
 default engine: ~AFAB speed with O(pp) instead of O(n_micro) boundary-
 activation memory.
+
+**Why no Megatron interleaved (virtual-stage) schedule**: with v chunks per
+device the pipeline deepens to V = v*pp virtual stages, and in a
+masked-uniform SPMD tick model every tick must trace each device's v
+forward + v backward units whether active or not — so fill/drain cost
+grows with V while per-tick cost grows with v, making interleaving
+STRICTLY worse here (efficiency n/(n + 2(V-1)) vs this schedule's
+n/(n + 2(pp-1))). Interleaving wins on per-rank imperative runtimes
+because idle warmup slots cost nothing; under jit they cost a full traced
+unit. Gating the units with lax.cond (the head-scoring trick) cannot
+recover it either: a skipped unit still occupies its tick slot in the
+schedule. The right lever for bubble fraction on TPU is more microbatches
+(n), which this full-rate schedule already amortizes at 2(pp-1)/n.
 """
 
 from __future__ import annotations
@@ -53,10 +66,9 @@ from jax import lax
 from picotron_tpu.config import Config
 from picotron_tpu.models.llama import (
     ParallelCtx, compute_dtype, embed, final_hidden, head_weight,
-    remat_policy_for, run_layers,
+    model_rope_tables, remat_policy_for, run_layers,
 )
 from picotron_tpu.ops.losses import IGNORE_INDEX, cross_entropy_sum_count
-from picotron_tpu.ops.rope import rope_tables
 
 
 def _vary_over(x, want):
@@ -219,7 +231,7 @@ def pipeline_loss_sum_count(params, ids, tgt, cfg: Config, ctx: ParallelCtx):
     n_micro, mbs, s_local = ids.shape
     n_ticks = n_micro + pp - 1
 
-    cos, sin = rope_tables(m.max_position_embeddings, m.head_dim, m.rope_theta)
+    cos, sin = model_rope_tables(m)
     dtype = compute_dtype(m)
     # Remat is applied at tick granularity below (so the policy governs what
     # the scan's AD saves per tick); disable the inner per-layer checkpoint
@@ -332,7 +344,7 @@ def pipeline_1f1b_grads(params, ids, tgt, cfg: Config, ctx: ParallelCtx):
     n_ticks = pp_1f1b_ticks(n_micro, pp)
     ring_slots = pp_1f1b_ring_slots(n_micro, pp)
 
-    cos, sin = rope_tables(m.max_position_embeddings, m.head_dim, m.rope_theta)
+    cos, sin = model_rope_tables(m)
     dtype = compute_dtype(m)
     stage_fn = _make_stage_fn(ids, tgt, m, ctx, cos, sin, s_idx, pp)
     fwd_perm = [(i, i + 1) for i in range(pp - 1)]

@@ -27,12 +27,10 @@ def teacher_forced_cache_logits(params, cfg, ids):
     greedy argmax flips on fp near-ties and the sequences then diverge
     completely, telling us nothing about cache correctness)."""
     from picotron_tpu.generate import _decode_layers, _logits_last, init_cache
-    from picotron_tpu.models.llama import compute_dtype
-    from picotron_tpu.ops.rope import rope_tables
+    from picotron_tpu.models.llama import compute_dtype, model_rope_tables
 
     b, n = ids.shape
-    cos, sin = rope_tables(cfg.max_position_embeddings, cfg.head_dim,
-                           cfg.rope_theta)
+    cos, sin = model_rope_tables(cfg)
     cache = init_cache(cfg, b, n)
     outs = []
     for t in range(n):

@@ -67,6 +67,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--eval-steps", type=int, default=8)
     p.add_argument("--eval-split", default=None)
     p.add_argument("--no-remat", action="store_true")
+    p.add_argument("--adam-moments-dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="bf16 halves optimizer-state memory (update math "
+                        "stays fp32) — usually required to fit >1B models "
+                        "per 16G chip; check with tools/memcheck.py")
     # dataset
     p.add_argument("--dataset", default="synthetic")
     p.add_argument("--subset", default=None)
@@ -127,6 +132,7 @@ def create_single_config(args) -> str:
             "total_train_steps": args.total_train_steps,
             "eval_frequency": args.eval_frequency,
             "eval_steps": args.eval_steps,
+            "adam_moments_dtype": args.adam_moments_dtype,
             "remat": not args.no_remat,
         },
         "dataset": {

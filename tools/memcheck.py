@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Will this config fit? — compile-only memory analysis, no chips needed.
+
+Compiles the full train step for a config on an AOT/virtual mesh and prints
+XLA's per-device memory breakdown (parameters + optimizer state, compiled
+temporaries, argument/output buffers). Run it before burning pod time on a
+layout that OOMs at step 1:
+
+  python tools/memcheck.py --config runs/llama2-7b-dp4tp2pp2-1f1b/config.json
+  python tools/memcheck.py --config cfg.json --sweep-mbs 1 2 4 8
+
+The config's own device topology is simulated on host CPUs (same recipe as
+the test suite), so a v5e-16 layout is analyzable on a laptop. Numbers are
+XLA's CPU-backend estimates: layouts/padding differ slightly from TPU
+compilation, but sizing decisions (does it fit in 16G with margin?) carry
+over. The reference has no equivalent — you find out by OOM-ing the job
+(its Slurm layer then greps the log, ref: base_job.slurm:82-94).
+
+Compile time scales with model size: debug-size configs analyze in
+seconds, multi-billion-parameter configs can take several minutes per mbs
+point on the CPU backend — still far cheaper than a pod job that OOMs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def analyze(cfg, mbs=None) -> dict:
+    import jax
+
+    from picotron_tpu.mesh import MeshEnv
+    from picotron_tpu.parallel.api import init_sharded_state, make_train_step
+
+    if mbs is not None:
+        cfg = dataclasses.replace(
+            cfg, training=dataclasses.replace(cfg.training,
+                                              micro_batch_size=mbs))
+    cfg.validate()
+    menv = MeshEnv.from_config(cfg)
+    # Abstract state + batch: nothing is materialized — a 7B config
+    # analyzes without 7B of host RAM (init_sharded_state(abstract=True)).
+    state = init_sharded_state(cfg, menv, jax.random.key(0), abstract=True)
+    step = make_train_step(cfg, menv)
+    t = cfg.training
+    b = (t.micro_batch_size * cfg.distributed.dp_size
+         * cfg.distributed.ep_size)
+    import jax.numpy as jnp
+
+    ids = jax.ShapeDtypeStruct(
+        (t.gradient_accumulation_steps, b, t.seq_length), jnp.int32,
+        sharding=menv.batch_sharding())
+    stats = step.lower(state, (ids, ids)).compile().memory_analysis()
+    gib = 1024 ** 3
+    return {
+        "micro_batch_size": t.micro_batch_size,
+        "per_device_gib": {
+            "arguments (params+moments+batch)":
+                round(stats.argument_size_in_bytes / gib, 3),
+            "temporaries": round(stats.temp_size_in_bytes / gib, 3),
+            "outputs": round(stats.output_size_in_bytes / gib, 3),
+            "total_estimate": round(
+                (stats.argument_size_in_bytes + stats.temp_size_in_bytes)
+                / gib, 3),
+        },
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="picotron-tpu memory analysis")
+    ap.add_argument("--config", required=True)
+    ap.add_argument("--sweep-mbs", type=int, nargs="*", default=None,
+                    help="analyze these micro-batch sizes instead of the "
+                         "config's")
+    args = ap.parse_args()
+
+    from picotron_tpu.config import load_config
+    from picotron_tpu.mesh import force_host_device_count
+
+    cfg = load_config(args.config)
+    # Simulate the config's topology on host CPUs (backend-init-order
+    # sensitive: must run before the first jax client exists).
+    force_host_device_count(cfg.distributed.world_size)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    for mbs in (args.sweep_mbs or [None]):
+        try:
+            print(json.dumps(analyze(cfg, mbs)))
+        except Exception as e:  # one OOM/compile failure must not end sweep
+            print(json.dumps({"micro_batch_size": mbs,
+                              "error": str(e)[:160]}))
+
+
+if __name__ == "__main__":
+    main()
