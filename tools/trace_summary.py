@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Summarize a jax.profiler trace into a per-op device-time table.
+
+The profiler story (SURVEY §5): `bench.py --profile DIR` or the training
+config's `logging.profile_dir` capture an xprof trace; TensorBoard renders
+it, but a pod/CI box usually has no browser — this prints the numbers that
+matter on stdout:
+
+  python tools/trace_summary.py /tmp/trace [--top 25] [--steps N]
+
+Reads the newest `*.trace.json.gz` under the directory, aggregates TPU
+device-side event durations by op name, and prints total ms (optionally
+/step with --steps) plus the share of device time. Top-level annotations
+(jit_step, the scan whiles, checkpoint/remat regions) appear alongside leaf
+fusions — read it hierarchically: `while.*` rows are the layer scans,
+`checkpoint.*` rows are remat recompute, `fusion.*`/`*dynamic-update-slice*`
+rows are leaf kernels inside them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def load_events(trace_dir: str):
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                  recursive=True),
+        key=os.path.getmtime)
+    if not paths:
+        sys.exit(f"no *.trace.json.gz under {trace_dir} — produce one with "
+                 f"`python bench.py --profile {trace_dir}` or a training "
+                 f"config's logging.profile_dir")
+    with gzip.open(paths[-1]) as f:
+        data = json.load(f)
+    return data.get("traceEvents", [])
+
+
+def summarize(events, device_substr: str = "TPU"):
+    pids = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pids[e["pid"]] = e["args"].get("name", "")
+    device_pids = {p for p, n in pids.items() if device_substr in n}
+    if not device_pids:  # CPU-backend traces: fall back to every process
+        device_pids = set(pids)
+    total_by_name = collections.Counter()
+    for e in events:
+        if (e.get("ph") == "X" and "dur" in e
+                and e.get("pid") in device_pids):
+            total_by_name[e["name"]] += e["dur"]
+    return total_by_name, {p: pids[p] for p in device_pids}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="xprof trace op summary")
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--steps", type=int, default=None,
+                    help="divide durations by N to report per-step ms")
+    ap.add_argument("--device", default="TPU",
+                    help="substring selecting device process rows")
+    args = ap.parse_args()
+
+    totals, procs = summarize(load_events(args.trace_dir), args.device)
+    if not totals:
+        sys.exit("no device events found in the trace")
+    grand = sum(totals.values())
+    div = args.steps or 1
+    unit = "ms/step" if args.steps else "ms total"
+    print(f"device processes: {sorted(set(procs.values()))}")
+    print(f"{'share':>6}  {unit:>12}  op")
+    for name, d in totals.most_common(args.top):
+        print(f"{d / grand * 100:5.1f}%  {d / 1e3 / div:12.2f}  {name[:90]}")
+
+
+if __name__ == "__main__":
+    main()
