@@ -143,7 +143,8 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=8)
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--no-remat", action="store_true")
-    ap.add_argument("--remat-policy", default="dots", choices=["full", "dots"])
+    ap.add_argument("--remat-policy", default="dots",
+                    choices=["full", "dots", "dots_norms"])
     ap.add_argument("--ce-chunk", type=int, default=0,
                     help="stream the LM-head CE over vocab chunks of this "
                          "size (0 = fused): ~tokens*vocab*2B less peak HBM "

@@ -385,7 +385,9 @@ class TrainingConfig:
     remat: bool = True
     # "full" recomputes everything in backward (max memory savings);
     # "dots" saves matmul outputs and recomputes only elementwise ops —
-    # usually within a few % of no-remat speed at a fraction of the memory.
+    # usually within a few % of no-remat speed at a fraction of the memory;
+    # "dots_norms" additionally saves RMSNorm outputs (~2 activations/layer
+    # more HBM, less backward recompute).
     remat_policy: str = "dots"
 
 
@@ -509,9 +511,10 @@ class Config:
             if m.expert_ffn_size % d.tp_size != 0:
                 raise ValueError(
                     "expert ffn size must be divisible by tp_size")
-        if t.remat_policy not in ("full", "dots"):
+        if t.remat_policy not in ("full", "dots", "dots_norms"):
             raise ValueError(
-                f"remat_policy must be 'full' or 'dots', got {t.remat_policy!r}")
+                f"remat_policy must be 'full', 'dots', or 'dots_norms', "
+                f"got {t.remat_policy!r}")
         if t.adam_moments_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"adam_moments_dtype must be 'float32' or 'bfloat16', got "

@@ -393,6 +393,14 @@ def remat_policy_for(name: str):
             jax.checkpoint_policies.save_only_these_names(
                 "attn_out", "attn_lse"),
         )
+    if name == "dots_norms":
+        # "dots" + the RMSNorm outputs: backward skips the fp32 norm
+        # recompute at ~2 extra saved activations per layer of HBM.
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "attn_lse", "norm_out"),
+        )
     return None
 
 
