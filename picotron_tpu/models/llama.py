@@ -384,22 +384,19 @@ def remat_policy_for(name: str):
     everything. Shared by the layer scan here and the pipeline tick scan
     (parallel/pp.py) so both paths honor the same config knob.
     """
-    if name == "dots":
+    if name in ("dots", "dots_norms"):
         # attn_lse rides along with attn_out (named inside the flash VJP's
         # fwd rule, ops/flash_attention.py) so the kernel's residuals are
         # fully saved and backward never re-runs the forward kernel.
+        # "dots_norms" additionally saves the RMSNorm outputs — backward
+        # skips the fp32 norm recompute at ~2 extra saved activations per
+        # layer of HBM (measured slower on v5e; PERF.md).
+        names = ("attn_out", "attn_lse")
+        if name == "dots_norms":
+            names += ("norm_out",)
         return jax.checkpoint_policies.save_from_both_policies(
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            jax.checkpoint_policies.save_only_these_names(
-                "attn_out", "attn_lse"),
-        )
-    if name == "dots_norms":
-        # "dots" + the RMSNorm outputs: backward skips the fp32 norm
-        # recompute at ~2 extra saved activations per layer of HBM.
-        return jax.checkpoint_policies.save_from_both_policies(
-            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            jax.checkpoint_policies.save_only_these_names(
-                "attn_out", "attn_lse", "norm_out"),
+            jax.checkpoint_policies.save_only_these_names(*names),
         )
     return None
 

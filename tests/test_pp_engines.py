@@ -129,7 +129,7 @@ def test_afab_remat_policy_reaches_pipeline_tick():
     the pp path used to blanket-full-remat regardless of policy)."""
     jaxprs = {}
     losses = {}
-    for policy in ("full", "dots"):
+    for policy in ("full", "dots", "dots_norms"):
         cfg = pp_cfg("afab", pp=2, gas=2, remat=True, remat_policy=policy)
         menv = MeshEnv.from_config(cfg)
         state = init_sharded_state(cfg, menv, jax.random.key(0))
@@ -139,4 +139,9 @@ def test_afab_remat_policy_reaches_pipeline_tick():
         _, metrics = step(state, batch)
         losses[policy] = float(metrics["loss"])
     assert jaxprs["full"] != jaxprs["dots"]
+    # dots_norms must actually differ from dots (a checkpoint_name typo
+    # would silently degrade it to dots) and keep the same numerics
+    assert jaxprs["dots_norms"] != jaxprs["dots"]
     np.testing.assert_allclose(losses["full"], losses["dots"], rtol=1e-6)
+    np.testing.assert_allclose(losses["full"], losses["dots_norms"],
+                               rtol=1e-6)
