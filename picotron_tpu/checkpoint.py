@@ -271,6 +271,16 @@ def load_hf_safetensors(path: str, cfg: ModelConfig,
     param pytree (fp32 master by default)."""
     raw = _read_safetensors_dir(path)
     nl = cfg.num_hidden_layers
+    file_layers = {int(mm.group(1)) for k in raw
+                   if (mm := re.match(r"model\.layers\.(\d+)\.", k))}
+    if file_layers and len(file_layers) != nl:
+        # A config expecting FEWER layers than the file holds would
+        # otherwise silently truncate the model (more layers fails later
+        # with a missing-tensor KeyError, but make both cases explicit).
+        raise ValueError(
+            f"checkpoint at {path} has {len(file_layers)} layers but the "
+            f"config expects num_hidden_layers={nl}; pass a matching model "
+            f"config")
 
     def get(name: str) -> np.ndarray:
         if name not in raw:

@@ -220,3 +220,15 @@ def test_hf_safetensors_roundtrip_qwen2_bias_tied(tmp_path):
     back2 = load_hf_safetensors(str(tmp_path / "hf"), untied)
     np.testing.assert_allclose(np.asarray(back2["lm_head"]),
                                np.asarray(head_weight(params)), rtol=1e-6)
+
+
+def test_hf_load_rejects_layer_count_mismatch(tmp_path):
+    """A config expecting fewer layers than the file holds must error, not
+    silently truncate the model (caught live: a 10-layer export loaded
+    through a 4-layer preset)."""
+    cfg10 = ModelConfig(dtype="float32", num_hidden_layers=6)
+    save_hf_safetensors(init_params(cfg10, jax.random.key(0)),
+                        str(tmp_path / "hf"))
+    with pytest.raises(ValueError, match="6 layers but the config"):
+        load_hf_safetensors(str(tmp_path / "hf"),
+                            ModelConfig(dtype="float32", num_hidden_layers=4))

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Export a framework training checkpoint to an HF safetensors directory.
+
+The round trip the reference never closes (its checkpoints are per-rank
+.pth files locked to a topology, ref: checkpoint.py:242-260): train here,
+export, then load anywhere `safetensors` does — HF `from_pretrained`
+(weights), vLLM, or back into this framework via `--hf-dir`/`init_from_hf`.
+
+  python tools/export_hf.py --config runs/exp/config.json \\
+      --ckpt-dir ckpt --out ./exported_hf
+
+Restores only the params subtree (no Adam moments — see tools/generate.py)
+and writes the canonical HF Llama/Qwen2/Mixtral tensor names, biases and
+tied heads included.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="picotron-tpu -> HF export")
+    ap.add_argument("--config", required=True,
+                    help="training config JSON of the run")
+    ap.add_argument("--ckpt-dir", required=True,
+                    help="checkpoint save_dir of the run")
+    ap.add_argument("--step", type=int, default=None,
+                    help="checkpoint step (default: newest durable)")
+    ap.add_argument("--out", required=True, help="output directory")
+    args = ap.parse_args()
+
+    import orbax.checkpoint as ocp
+
+    from picotron_tpu.checkpoint import CheckpointManager, save_hf_safetensors
+    from picotron_tpu.config import load_config
+    from picotron_tpu.mesh import MeshEnv
+    from picotron_tpu.models.llama import (
+        init_params, pad_layers_for_pp, unpad_layers,
+    )
+
+    cfg = load_config(args.config)
+    menv = MeshEnv.create(dp=1, devices=jax.devices()[:1])
+    mgr = CheckpointManager(cfg, menv, directory=args.ckpt_dir)
+    step_n = args.step if args.step is not None else mgr.latest_step()
+    if step_n is None:
+        ap.error(f"no checkpoints under {args.ckpt_dir}")
+
+    nl, pp = cfg.model.num_hidden_layers, cfg.distributed.pp_size
+    abstract = jax.eval_shape(
+        lambda: pad_layers_for_pp(init_params(cfg.model, jax.random.key(0)),
+                                  nl, pp))
+    sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    restore_args = jax.tree.map(
+        lambda x: ocp.ArrayRestoreArgs(dtype=x.dtype, sharding=sharding),
+        abstract)
+    with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
+        restored = ckptr.restore(
+            f"{mgr.directory}/step_{step_n:08d}/state",
+            args=ocp.args.PyTreeRestore(
+                item={"params": abstract},
+                restore_args={"params": restore_args},
+                partial_restore=True))
+    params = unpad_layers(restored["params"], nl, pp)
+    save_hf_safetensors(params, args.out)
+    print(f"exported step {step_n} -> {args.out}/model.safetensors")
+
+
+if __name__ == "__main__":
+    main()
