@@ -209,6 +209,44 @@ class CheckpointManager:
         return state, meta
 
 
+def restore_params_only(cfg: Config, ckpt_dir: str,
+                        step: Optional[int] = None):
+    """Restore ONLY the canonical [L]-stacked params from a training
+    checkpoint onto the first local device — the inference/export path
+    (tools/generate.py, tools/export_hf.py). Skips the Adam moments
+    entirely (a partial PyTree restore: ~1/3 the IO and host memory of a
+    full-state restore at 7B scale) and unpads the PP layer stack."""
+    import orbax.checkpoint as ocp
+
+    from picotron_tpu.mesh import MeshEnv
+    from picotron_tpu.models.llama import (
+        init_params, pad_layers_for_pp, unpad_layers,
+    )
+
+    menv = MeshEnv.create(dp=1, devices=jax.devices()[:1])
+    mgr = CheckpointManager(cfg, menv, directory=ckpt_dir)
+    if step is None:
+        step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    nl, pp = cfg.model.num_hidden_layers, cfg.distributed.pp_size
+    abstract = jax.eval_shape(
+        lambda: pad_layers_for_pp(init_params(cfg.model, jax.random.key(0)),
+                                  nl, pp))
+    sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    restore_args = jax.tree.map(
+        lambda x: ocp.ArrayRestoreArgs(dtype=x.dtype, sharding=sharding),
+        abstract)
+    with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
+        restored = ckptr.restore(
+            os.path.join(mgr.directory, f"step_{step:08d}", "state"),
+            args=ocp.args.PyTreeRestore(
+                item={"params": abstract},
+                restore_args={"params": restore_args},
+                partial_restore=True))
+    return unpad_layers(restored["params"], nl, pp), step
+
+
 # ---------------------------------------------------------------------------
 # HF safetensors import (ref: checkpoint.py:50-230)
 # ---------------------------------------------------------------------------

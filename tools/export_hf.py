@@ -36,38 +36,11 @@ def main() -> None:
     ap.add_argument("--out", required=True, help="output directory")
     args = ap.parse_args()
 
-    import orbax.checkpoint as ocp
-
-    from picotron_tpu.checkpoint import CheckpointManager, save_hf_safetensors
+    from picotron_tpu.checkpoint import restore_params_only, save_hf_safetensors
     from picotron_tpu.config import load_config
-    from picotron_tpu.mesh import MeshEnv
-    from picotron_tpu.models.llama import (
-        init_params, pad_layers_for_pp, unpad_layers,
-    )
 
     cfg = load_config(args.config)
-    menv = MeshEnv.create(dp=1, devices=jax.devices()[:1])
-    mgr = CheckpointManager(cfg, menv, directory=args.ckpt_dir)
-    step_n = args.step if args.step is not None else mgr.latest_step()
-    if step_n is None:
-        ap.error(f"no checkpoints under {args.ckpt_dir}")
-
-    nl, pp = cfg.model.num_hidden_layers, cfg.distributed.pp_size
-    abstract = jax.eval_shape(
-        lambda: pad_layers_for_pp(init_params(cfg.model, jax.random.key(0)),
-                                  nl, pp))
-    sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
-    restore_args = jax.tree.map(
-        lambda x: ocp.ArrayRestoreArgs(dtype=x.dtype, sharding=sharding),
-        abstract)
-    with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
-        restored = ckptr.restore(
-            f"{mgr.directory}/step_{step_n:08d}/state",
-            args=ocp.args.PyTreeRestore(
-                item={"params": abstract},
-                restore_args={"params": restore_args},
-                partial_restore=True))
-    params = unpad_layers(restored["params"], nl, pp)
+    params, step_n = restore_params_only(cfg, args.ckpt_dir, step=args.step)
     save_hf_safetensors(params, args.out)
     print(f"exported step {step_n} -> {args.out}/model.safetensors")
 

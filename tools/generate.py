@@ -63,42 +63,9 @@ def main() -> None:
             ap.error("--ckpt-dir needs --config <json>")
         cfg: Config = load_config(args.config)
         cfg_m = cfg.model
-        import orbax.checkpoint as ocp
+        from picotron_tpu.checkpoint import restore_params_only
 
-        from picotron_tpu.checkpoint import CheckpointManager
-        from picotron_tpu.mesh import MeshEnv
-        from picotron_tpu.models.llama import (
-            init_params, pad_layers_for_pp, unpad_layers,
-        )
-
-        menv = MeshEnv.create(dp=1, devices=jax.devices()[:1])
-        mgr = CheckpointManager(cfg, menv, directory=args.ckpt_dir)
-        step_n = mgr.latest_step()
-        if step_n is None:
-            ap.error(f"no checkpoints under {args.ckpt_dir}")
-        # Params-only restore: decode needs no Adam moments, and restoring
-        # them would cost ~3x the IO and ~3x the host memory of the params
-        # (an OOM at 7B scale). ocp.PLACEHOLDER skips the opt_state/step
-        # entries entirely. The template carries the training run's
-        # PP-padded layer-stack shapes; the canonical [L] stack is gathered
-        # back out for decoding.
-        nl, pp = cfg_m.num_hidden_layers, cfg.distributed.pp_size
-        abstract = jax.eval_shape(
-            lambda: pad_layers_for_pp(init_params(cfg_m, jax.random.key(0)),
-                                      nl, pp))
-        path = f"{mgr.directory}/step_{step_n:08d}/state"
-        sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
-        restore_args = jax.tree.map(
-            lambda x: ocp.ArrayRestoreArgs(dtype=x.dtype, sharding=sharding),
-            abstract)
-        with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
-            restored = ckptr.restore(
-                path,
-                args=ocp.args.PyTreeRestore(
-                    item={"params": abstract},
-                    restore_args={"params": restore_args},
-                    partial_restore=True))
-        params = unpad_layers(restored["params"], nl, pp)
+        params, _ = restore_params_only(cfg, args.ckpt_dir)
 
     tokenizer = None
     if args.prompt is not None:
