@@ -13,8 +13,8 @@ with the same config run in a single process.
 Layouts are chosen so the axis that spans the process boundary varies:
 devices enumerate process-major, and the mesh grid is (dp, pp, ep, cp, tp)
 row-major, so the outermost nontrivial axis is the one whose collectives
-cross gloo — dp (gradient psum) in one layout, pp (boundary ppermute) in
-the other.
+cross gloo — dp (gradient psum), pp (boundary ppermute), and ep (MoE
+dispatch all_to_all) each get a layout.
 """
 
 import json
@@ -40,10 +40,10 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _write_cfg(tmp_path, distributed):
+def _write_cfg(tmp_path, distributed, model="debug-tiny"):
     cfg = {
         "distributed": {"use_cpu": True, **distributed},
-        "model": {"name": "debug-tiny", "dtype": "float32"},
+        "model": {"name": model, "dtype": "float32"},
         "training": {"total_train_steps": STEPS, "seq_length": 32,
                      "micro_batch_size": 2,
                      "gradient_accumulation_steps": 2,
@@ -104,9 +104,14 @@ def _run_single(cfg_path):
     # pp spans the process boundary: cross-process pipeline ppermute
     # (dp=1, so pp is outermost nontrivial); afab engine for AD coverage
     {"pp_size": 2, "cp_size": 2, "tp_size": 2, "pp_engine": "afab"},
+    # ep spans the process boundary: cross-process MoE dispatch all_to_all
+    # (the one collective family the other layouts don't exercise)
+    {"ep_size": 2, "cp_size": 2, "tp_size": 2, "_model": "debug-tiny-moe"},
 ])
 def test_two_process_training_matches_single(tmp_path, layout):
-    cfg_path = _write_cfg(tmp_path, layout)
+    layout = dict(layout)
+    model = layout.pop("_model", "debug-tiny")
+    cfg_path = _write_cfg(tmp_path, layout, model=model)
     single = _run_single(cfg_path)
     assert len(single) == STEPS and all(np.isfinite(single))
 
