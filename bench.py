@@ -25,21 +25,30 @@ import time
 import jax
 import jax.numpy as jnp
 
-# (model, layers [None = preset depth], seq, mbs) — ordered so the headline
-# metric is the LAST line, keeping `python bench.py --sweep | tail -1`
-# compatible with the single-run output.
+# (model, layers [None = preset depth], seq, mbs, extra-kwargs) — ordered so
+# the headline metric is the LAST line, keeping `python bench.py --sweep |
+# tail -1` compatible with the single-run output.
+OFFLOAD_24L = dict(grad_acc=64, remat_policy="full", optimizer_offload=True)
 SWEEP = [
-    ("SmolLM-360M", None, 2048, 6),   # full-depth model, no reduction
-    ("SmolLM-1.7B", 8, 4096, 2),
-    ("SmolLM-1.7B", 4, 16384, 1),     # long-context: blocked-KV flash
-    ("SmolLM-1.7B", 8, 2048, 5),      # headline
+    ("SmolLM-360M", None, 2048, 6, {}),   # full-depth model, no reduction
+    ("SmolLM-1.7B", 8, 4096, 2, {}),
+    ("SmolLM-1.7B", 4, 16384, 1, {}),     # long-context: blocked-KV flash
+    ("SmolLM-1.7B", 8, 2048, 5, {}),      # depth-reduced peak-MFU config
+    # headline: the FULL 24-layer model on one chip — fp32 master + Adam
+    # moments live in pinned host memory (optimizer_offload), grad-acc 64
+    # amortizes the PCIe round trip (mbs 2 x 64 x 2048 = 262k tokens/step
+    # = SmolLM's real ~2M-token global batch at the reference's 8-GPU
+    # scale). Matches the reference's full-depth ~50% bar honestly
+    # (ref: README.md:7).
+    ("SmolLM-1.7B", None, 2048, 2, OFFLOAD_24L),
 ]
 
 
 def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
             steps: int = 8, warmup: int = 2, remat: bool = True,
             remat_policy: str = "dots", adam_moments_dtype: str = "bfloat16",
-            ce_chunk: int = 0, profile: str | None = None) -> dict:
+            ce_chunk: int = 0, optimizer_offload: bool = False,
+            profile: str | None = None) -> dict:
     from picotron_tpu.config import (
         Config, DistributedConfig, ModelConfig, TrainingConfig, resolve_preset,
     )
@@ -65,6 +74,7 @@ def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
             remat_policy=remat_policy,
             adam_moments_dtype=adam_moments_dtype,
             ce_chunk_size=ce_chunk,
+            optimizer_offload=optimizer_offload,
         ),
     )
     cfg.validate()
@@ -130,27 +140,35 @@ def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    # Defaults = the best-known single-chip v5e config: a depth-reduced
-    # SmolLM-1.7B (8 of 24 layers, mbs 5 — the r3 sweet spot; mbs 6 OOMs) —
-    # the full model's fp32 master params + grads + moments need >17G and
-    # do not fit one 16G chip; per-layer efficiency matches the full model
-    # and the metric name records the reduction honestly. SmolLM-360M in
-    # --sweep is the full-model metric.
+    # Default (no flags) = the HEADLINE config: the full 24-layer
+    # SmolLM-1.7B on one chip via optimizer_offload (fp32 master + Adam
+    # moments in pinned host memory), mbs 2 x grad-acc 64 = 262k
+    # tokens/step — SmolLM's real ~2M-token global batch at the
+    # reference's 8-GPU scale. Any explicit shape flag opts out of the
+    # auto-config (see the resolution block below); `--layers 8 --mbs 5`
+    # reproduces the depth-reduced peak-MFU proxy (62.6%, PERF.md).
     ap.add_argument("--model", default="SmolLM-1.7B")
     ap.add_argument("--seq", type=int, default=2048)
-    ap.add_argument("--mbs", type=int, default=5)
-    ap.add_argument("--grad-acc", type=int, default=1)
+    ap.add_argument("--mbs", type=int, default=None)
+    ap.add_argument("--grad-acc", type=int, default=None)
     ap.add_argument("--steps", type=int, default=8)
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--no-remat", action="store_true")
-    ap.add_argument("--remat-policy", default="dots",
-                    choices=["full", "dots", "dots_norms"])
+    ap.add_argument("--remat-policy", default=None,
+                    choices=["full", "dots", "dots_attn", "dots_norms"])
     ap.add_argument("--ce-chunk", type=int, default=0,
                     help="stream the LM-head CE over vocab chunks of this "
                          "size (0 = fused): ~tokens*vocab*2B less peak HBM "
                          "for one extra chunk matmul in backward — a "
                          "memory knob for big-vocab models (Llama-3 128k); "
                          "costs ~5%% MFU at SmolLM shapes (PERF.md)")
+    ap.add_argument("--optimizer-offload", action="store_true",
+                    help="ZeRO-Offload-style optimizer-state offload: fp32 "
+                         "master + Adam moments live in pinned HOST memory, "
+                         "the device keeps a bf16 compute copy — the lever "
+                         "that fits full-depth SmolLM-1.7B (~21 GB of "
+                         "state) on one 15.75 GB chip. Amortize the PCIe "
+                         "round trip with --grad-acc >= 16")
     ap.add_argument("--adam-moments-dtype", default="bfloat16",
                     choices=["float32", "bfloat16"],
                     help="bf16 moments halve optimizer-state HBM traffic "
@@ -178,10 +196,12 @@ def main() -> None:
         # (attr name -> (default, real flag spelling), so the error names
         # flags the user can actually type; ADVICE r2)
         defaults = {"model": ("SmolLM-1.7B", "--model"),
-                    "seq": (2048, "--seq"), "mbs": (5, "--mbs"),
-                    "grad_acc": (1, "--grad-acc"),
+                    "seq": (2048, "--seq"), "mbs": (None, "--mbs"),
+                    "grad_acc": (None, "--grad-acc"),
                     "layers": (None, "--layers"),
                     "ce_chunk": (0, "--ce-chunk"),
+                    "remat_policy": (None, "--remat-policy"),
+                    "optimizer_offload": (False, "--optimizer-offload"),
                     "profile": (None, "--profile"),
                     "no_remat": (False, "--no-remat")}
         clashing = [flag for k, (v, flag) in defaults.items()
@@ -189,13 +209,17 @@ def main() -> None:
         if clashing:
             ap.error(f"--sweep runs a fixed config matrix; incompatible "
                      f"with: {', '.join(clashing)}")
-        for model, layers, seq, mbs in SWEEP:
+        for model, layers, seq, mbs, extra in SWEEP:
             depth = layers or resolve_preset(model)["num_hidden_layers"]
+            # dict-literal merge: `extra` may override remat_policy (the
+            # OFFLOAD_24L headline does) — dict(k=..., **extra) would raise
+            kw = {"remat_policy": "dots", **extra}
             try:
                 print(json.dumps(run_one(
                     model, layers, seq, mbs, steps=args.steps,
-                    warmup=args.warmup, remat_policy=args.remat_policy,
-                    adam_moments_dtype=args.adam_moments_dtype)), flush=True)
+                    warmup=args.warmup,
+                    adam_moments_dtype=args.adam_moments_dtype, **kw)),
+                    flush=True)
             except Exception as e:  # one OOM must not kill the matrix
                 print(json.dumps({
                     "metric": f"mfu_{model.split('/')[-1]}-{depth}L_seq{seq}",
@@ -203,14 +227,28 @@ def main() -> None:
                 }), flush=True)
         return
 
-    if args.layers is None and args.model == "SmolLM-1.7B":
-        args.layers = 8  # the full model's optimizer state exceeds one chip
+    # Flag resolution: the bare default is the full-depth headline config
+    # (offload + mbs 2 x ga 64 + full remat). Asking for a depth-reduced
+    # variant (--layers) opts out of offload; everything else fills in the
+    # per-mode defaults.
+    if args.model == "SmolLM-1.7B" and args.layers is None \
+            and not args.optimizer_offload:
+        args.optimizer_offload = True
+    if args.optimizer_offload:
+        args.layers = args.layers or 0
+        args.mbs = args.mbs or 2
+        args.grad_acc = args.grad_acc or 64
+        args.remat_policy = args.remat_policy or "full"
+    else:
+        args.mbs = args.mbs or 5
+        args.grad_acc = args.grad_acc or 1
+        args.remat_policy = args.remat_policy or "dots"
     print(json.dumps(run_one(
         args.model, args.layers, args.seq, args.mbs, grad_acc=args.grad_acc,
         steps=args.steps, warmup=args.warmup, remat=not args.no_remat,
         remat_policy=args.remat_policy,
         adam_moments_dtype=args.adam_moments_dtype, ce_chunk=args.ce_chunk,
-        profile=args.profile)))
+        optimizer_offload=args.optimizer_offload, profile=args.profile)))
 
 
 if __name__ == "__main__":

@@ -124,9 +124,21 @@ class CheckpointManager:
             return False
         try:
             return bool(self._ocp.utils.is_checkpoint_finalized(state_dir))
-        except Exception:
-            return True  # finalization metadata unreadable: posix rename
-            #              already happened, treat the rename as the commit
+        except ValueError:
+            # "not an Orbax-managed checkpoint path": on posix the atomic
+            # rename into place IS the commit, so an existing dir without
+            # Orbax finalization metadata is durable.
+            return True
+        except Exception as e:  # noqa: BLE001
+            # Transient metadata read errors (GCS-style stores — exactly
+            # the case the finalization check exists for) must NOT classify
+            # an in-flight/torn checkpoint as durable (ADVICE r3). Skip it;
+            # a genuinely durable step is re-discovered on the next probe.
+            import warnings
+
+            warnings.warn(f"checkpoint durability probe failed for "
+                          f"{state_dir}: {e!r}; treating as not durable")
+            return False
 
     def latest_step(self) -> Optional[int]:
         """Newest *durable* checkpoint step. An async save that has not

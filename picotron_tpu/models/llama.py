@@ -34,6 +34,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from picotron_tpu.config import ModelConfig
 from picotron_tpu.ops.attention import sdpa_attention
@@ -122,6 +123,15 @@ class ParallelCtx:
     remat: bool = False
     # "full" | "dots" (save matmul outputs, recompute elementwise only)
     remat_policy: str = "dots"
+    # (n_slots) -> float32[n_slots] mask of REAL (non-pad) layer slots in
+    # this device's stacked-layer slice. Uneven-PP padding adds all-zero
+    # identity layers (pp_layer_placement); their router statistics must not
+    # enter the MoE aux loss / drop metric, and the mask is derived from the
+    # STATIC placement (stage index + remainder rule), not from sniffing
+    # router weights — a legitimately zero-initialized router would
+    # otherwise lose its balance/z gradients silently (ADVICE r3). None =
+    # every slot is real.
+    layer_is_real: Optional[Callable] = None
 
 
 DEFAULT_CTX = ParallelCtx()
@@ -313,6 +323,11 @@ def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
     # sequence parallelism an all_gather that restores the full sequence
     b, s, _ = h.shape
     q, k, v = qkv_proj(h, lp, d)
+    # one shared name: the "dots_attn" policy saves the attention-side dots
+    # (the flash VJP's inputs) while the MLP recomputes — the memory/flops
+    # midpoint between "dots" and "full" (the MLP's gate/up activations are
+    # ~2/3 of a layer's saved bytes but its matmuls only ~+7% of step flops)
+    q, k, v = (checkpoint_name(t, "qkv_out") for t in (q, k, v))
     n_q = q.shape[2]
 
     # K/V stay unexpanded (n_kv heads) — attention impls handle GQA so the
@@ -324,6 +339,7 @@ def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
     # kernel residuals exactly once and backward never re-runs the forward.
     out = out.reshape(b, s, n_q * d)
     out = out @ lp["o"].astype(dt)
+    out = checkpoint_name(out, "attn_proj_out")
     return ctx.g(out)  # row-parallel exit: psum-over-tp fwd / identity bwd
 
 
@@ -338,7 +354,7 @@ def _mlp_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx):
     return ctx.g(out)
 
 
-def _moe_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx):
+def _moe_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, is_real):
     """RMSNorm -> top-k routed expert SwiGLU bank (beyond the reference;
     ops/moe.py). Returns (out, aux [2])."""
     from picotron_tpu.ops.moe import moe_mlp
@@ -358,19 +374,22 @@ def _moe_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx):
     # Zero-padded PP layer slots (pad_layers_for_pp) must not contribute
     # router statistics: their all-zero router yields uniform logits whose
     # z-loss (log(E)^2 per token) and tie-broken top-k capacity overflow
-    # would pollute the loss and the drop metric (code review r3). A real
-    # layer's random-init router is never exactly all-zero.
-    is_real = jnp.any(lp["router"] != 0).astype(jnp.float32)
+    # would pollute the loss and the drop metric (code review r3). `is_real`
+    # comes from the static placement (ctx.layer_is_real via run_layers),
+    # not from the weights (ADVICE r3).
     return ctx.g(out), ctx.moe_aux_sync(jnp.stack([aux, drop]) * is_real)
 
 
-def decoder_layer(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
+def decoder_layer(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin,
+                  is_real=1.0):
     """Returns (x, aux [2]) — aux[0] is the pre-weighted router loss
     (balance + z, 0 for dense models), aux[1] the capacity drop fraction
-    (observability; stop_gradient-free but weightless in the loss)."""
+    (observability; stop_gradient-free but weightless in the loss).
+    `is_real` masks the aux of zero-padded PP layer slots (see
+    ParallelCtx.layer_is_real)."""
     x = x + _attention_block(x, lp, cfg, ctx, cos, sin)
     if cfg.num_experts:
-        mlp_out, aux = _moe_block(x, lp, cfg, ctx)
+        mlp_out, aux = _moe_block(x, lp, cfg, ctx, is_real)
     else:
         mlp_out, aux = _mlp_block(x, lp, cfg, ctx), jnp.zeros(2, jnp.float32)
     return x + mlp_out, aux
@@ -398,6 +417,15 @@ def remat_policy_for(name: str):
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             jax.checkpoint_policies.save_only_these_names(*names),
         )
+    if name == "dots_attn":
+        # Save only the attention-side dots (qkv projections, the flash
+        # kernel's out/lse residuals, the o-projection) and recompute the
+        # MLP in backward: ~2.6x less saved-activation HBM than "dots" for
+        # ~+7% step FLOPs (gate/up matmul recompute) — the policy that fits
+        # full-depth SmolLM-1.7B beside optimizer_offload's fp32 grad tree
+        # on one v5e chip (PERF.md round 4).
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "attn_lse", "qkv_out", "attn_proj_out")
     return None
 
 
@@ -414,15 +442,19 @@ def run_layers(layer_params: Params, x: jnp.ndarray, cfg: ModelConfig,
     if cos is None:
         cos, sin = model_rope_tables(cfg)
 
-    def body(h, lp):
-        h, aux = decoder_layer(h, lp, cfg, ctx, cos, sin)
+    def body(h, xs):
+        lp, real = xs
+        h, aux = decoder_layer(h, lp, cfg, ctx, cos, sin, real)
         # aux rides the scan's stacked outputs (not the carry: its varying
         # mesh axes differ from x's, which would unstabilize the carry type)
         return h, aux
 
+    n_slots = jax.tree.leaves(layer_params)[0].shape[0]
+    real = (ctx.layer_is_real(n_slots) if ctx.layer_is_real is not None
+            else jnp.ones((n_slots,), jnp.float32))
     if ctx.remat:
         body = jax.checkpoint(body, policy=remat_policy_for(ctx.remat_policy))
-    x, aux_per_layer = jax.lax.scan(body, x, layer_params)  # [L, 2]
+    x, aux_per_layer = jax.lax.scan(body, x, (layer_params, real))  # [L, 2]
     return x, jnp.sum(aux_per_layer, axis=0)
 
 

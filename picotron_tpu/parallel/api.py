@@ -33,7 +33,9 @@ from picotron_tpu.mesh import MeshEnv
 from picotron_tpu.models.llama import (
     ParallelCtx, init_params, loss_sum_count, pad_layers_for_pp,
 )
-from picotron_tpu.optimizer import make_optimizer
+from picotron_tpu.optimizer import (
+    OffloadAdamState, make_optimizer, offload_adam_update,
+)
 from picotron_tpu.parallel.sharding import batch_spec, param_shardings, param_specs
 from picotron_tpu.parallel.tp import (
     gather_logits,
@@ -171,10 +173,22 @@ def make_parallel_ctx(cfg: Config) -> ParallelCtx:
             moe_aux_sync=lambda a: lax.pmean(a, "tp"),
         )
 
+    # Uneven-PP padding: mask the aux statistics of pad slots from the
+    # STATIC placement rule (pp_layer_placement puts each stage's real
+    # layers in its leading slots; remainder to early stages) rather than
+    # sniffing router weights (ADVICE r3).
+    L, pp = cfg.model.num_hidden_layers, d.pp_size
+    layer_is_real = None
+    if pp > 1 and L % pp != 0:
+        def layer_is_real(n_slots):
+            cnt = L // pp + (lax.axis_index("pp") < L % pp).astype(jnp.int32)
+            return (jnp.arange(n_slots) < cnt).astype(jnp.float32)
+
     return ParallelCtx(
         attn=attn,
         gather_logits=partial(gather_logits, axis="tp"),
         positions=positions,
+        layer_is_real=layer_is_real,
         moe_ep_axis="ep",
         # layout-exact router statistics: pmean f/P/z over the data axes so
         # the aux losses describe the global batch (config.router_aux_global)
@@ -255,8 +269,7 @@ def _device_grads(params, batch, cfg: Config):
         nll_total = lax.psum(nll_total, ("dp", "ep", "cp"))
         dropw = lax.psum(dropw, ("dp", "ep", "cp"))
         count = jnp.maximum(lax.psum(count, ("dp", "ep", "cp")), 1)
-        return (jax.tree.map(lambda g: g / count, grads), nll_total / count,
-                _normalize_extras(dropw, count, cfg))
+        return _finish_grads(grads, nll_total, count, dropw, cfg)
 
     def nll_sum(params, mb_ids, mb_tgt):
         total, count, extras = loss_sum_count(params, mb_ids, mb_tgt,
@@ -278,8 +291,14 @@ def _device_grads(params, batch, cfg: Config):
     # varies over (expert banks arrive ep-varying from their sharding).
     from picotron_tpu.parallel.pp import _vary_over
 
+    # fp32 accumulation regardless of the param dtype: with optimizer_offload
+    # the params (hence per-microbatch grads) are bf16; summing grad-acc
+    # microbatches in bf16 would lose exactly the low bits the fp32 master
+    # exists to keep (jnp.add promotes bf16 + fp32 -> fp32).
     zeros = jax.tree.map(
-        lambda p: _vary_over(jnp.zeros_like(p), {"dp", "ep", "cp"}), params)
+        lambda p: _vary_over(jnp.zeros(p.shape, jnp.float32),
+                             {"dp", "ep", "cp"} | set(jax.typeof(p).vma)),
+        params)
     init_carry = (zeros,) + lax.pcast(
         (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32),
          jnp.zeros((), jnp.float32)),
@@ -292,9 +311,23 @@ def _device_grads(params, batch, cfg: Config):
     nll_total = lax.psum(nll_total, ("dp", "ep", "cp"))
     dropw = lax.psum(dropw, ("dp", "ep", "cp"))
     count = jnp.maximum(lax.psum(count, ("dp", "ep", "cp")), 1)
-    grads = jax.tree.map(lambda g: g / count, grads)
-    loss = nll_total / count
-    return grads, loss, _normalize_extras(dropw, count, cfg)
+    return _finish_grads(grads, nll_total, count, dropw, cfg)
+
+
+def _finish_grads(grads, nll_total, count, dropw, cfg: Config):
+    """Final token-mean normalization. Under optimizer_offload the grads are
+    returned UN-divided with the 1/count scale riding in extras: the
+    elementwise division would materialize a second 6.75 GB fp32 grad tree
+    (it cannot fuse across the while-loop boundary into the streamed update
+    scan) — measured as ~6 GB of "fragmentation" that OOMed full-depth
+    SmolLM-1.7B. offload_adam_update folds the scale into its slice math
+    instead."""
+    extras = _normalize_extras(dropw, count, cfg)
+    if cfg.training.optimizer_offload:
+        extras["_grad_scale"] = 1.0 / count.astype(jnp.float32)
+        return grads, nll_total / count, extras
+    return (jax.tree.map(lambda g: g / count, grads), nll_total / count,
+            extras)
 
 
 def make_train_step(cfg: Config, menv: MeshEnv):
@@ -309,7 +342,6 @@ def make_train_step(cfg: Config, menv: MeshEnv):
     mesh = menv.mesh
     pspecs = param_specs(cfg)
     bspec = batch_spec()
-    opt = make_optimizer(cfg.training)
 
     grad_fn = jax.shard_map(
         partial(_device_grads, cfg=cfg),
@@ -317,6 +349,27 @@ def make_train_step(cfg: Config, menv: MeshEnv):
         in_specs=(pspecs, (bspec, bspec)),
         out_specs=(pspecs, P(), P()),  # P() prefixes the extras dict
     )
+
+    if cfg.training.optimizer_offload:
+        from picotron_tpu.models.llama import compute_dtype
+
+        shardings = param_shardings(cfg, mesh)
+        cdt = compute_dtype(cfg.model)
+        kind = offload_memory_kind(mesh)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state: TrainState, batch):
+            grads, loss, extras = grad_fn(state.params, batch)
+            grad_scale = extras.pop("_grad_scale")
+            new_params, new_opt = offload_adam_update(
+                grads, state.opt_state, cfg.training, shardings, cdt,
+                memory_kind=kind, grad_scale=grad_scale)
+            metrics = {"loss": loss, **extras}
+            return TrainState(new_params, new_opt, state.step + 1), metrics
+
+        return step
+
+    opt = make_optimizer(cfg.training)
 
     @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, batch):
@@ -390,6 +443,9 @@ def init_sharded_state(cfg: Config, menv: MeshEnv, key: jax.Array,
                                  cfg.model.num_hidden_layers,
                                  cfg.distributed.pp_size)
 
+    if cfg.training.optimizer_offload:
+        return _init_offload_state(cfg, menv, key, init, shardings, abstract)
+
     if abstract:
         params = jax.tree.map(
             lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
@@ -439,6 +495,94 @@ def init_sharded_state(cfg: Config, menv: MeshEnv, key: jax.Array,
         opt_state = jax.jit(opt.init, out_shardings=opt_shardings)(params)
         step0 = jax.device_put(jnp.zeros((), jnp.int32), replicated)
     return TrainState(params=params, opt_state=opt_state, step=step0)
+
+
+def offload_memory_kind(mesh) -> str | None:
+    """'pinned_host' on TPU, None elsewhere. On the CPU backend "device"
+    memory IS host RAM, and XLA:CPU's pinned_host plumbing cannot round-trip
+    donated buffers through jit outputs — so the simulated-mesh tests run
+    the offload code path placement-free (same math, same state layout)
+    while real chips get genuine host placement."""
+    return ("pinned_host"
+            if mesh.devices.flat[0].platform == "tpu" else None)
+
+
+def _init_offload_state(cfg: Config, menv: MeshEnv, key, init,
+                        dev_shardings, abstract: bool) -> TrainState:
+    """optimizer_offload state layout: fp32 master + Adam moments in pinned
+    host memory (sharded exactly like their params), bf16 compute copy + an
+    int32 step counter on device. See OffloadAdamState."""
+    from picotron_tpu.models.llama import compute_dtype
+
+    mesh = menv.mesh
+    host_shardings = param_shardings(cfg, mesh,
+                                     memory_kind=offload_memory_kind(mesh))
+    cdt = compute_dtype(cfg.model)
+    mdt = (jnp.bfloat16 if cfg.training.adam_moments_dtype == "bfloat16"
+           else jnp.float32)
+    replicated = NamedSharding(mesh, P())
+    abs_master = jax.eval_shape(init, key)
+
+    if abstract:
+        sds = lambda a, dt, s: jax.ShapeDtypeStruct(  # noqa: E731
+            a.shape, dt, sharding=s)
+        master = jax.tree.map(lambda a, s: sds(a, a.dtype, s),
+                              abs_master, host_shardings)
+        params = jax.tree.map(lambda a, s: sds(a, cdt, s),
+                              abs_master, dev_shardings)
+        mu = jax.tree.map(lambda a, s: sds(a, mdt, s),
+                          abs_master, host_shardings)
+        nu = jax.tree.map(lambda a, s: sds(a, mdt, s),
+                          abs_master, host_shardings)
+        count = jax.ShapeDtypeStruct((), jnp.int32, sharding=replicated)
+        step0 = jax.ShapeDtypeStruct((), jnp.int32, sharding=replicated)
+    else:
+        # Stage through device shardings and device_put to host OUTSIDE jit:
+        # XLA's SPMD partitioner rejects host-memory-kind out_shardings on a
+        # multi-device mesh ("side-effect HLO must have sharding"), while
+        # plain device_put transfers (and device_put inside jit, which the
+        # train step uses) partition fine.
+        master_dev = jax.jit(init, out_shardings=dev_shardings)(key)
+        params = jax.jit(
+            lambda mp: jax.tree.map(lambda x: x.astype(cdt), mp),
+            out_shardings=dev_shardings)(master_dev)
+        master = jax.device_put(master_dev, host_shardings)
+        zeros = jax.jit(
+            lambda: jax.tree.map(lambda a: jnp.zeros(a.shape, mdt),
+                                 abs_master),
+            out_shardings=dev_shardings)
+        mu = jax.device_put(zeros(), host_shardings)
+        nu = jax.device_put(zeros(), host_shardings)
+        count = jax.device_put(jnp.zeros((), jnp.int32), replicated)
+        step0 = jax.device_put(jnp.zeros((), jnp.int32), replicated)
+    opt_state = OffloadAdamState(count=count, master=master, mu=mu, nu=nu)
+    return TrainState(params=params, opt_state=opt_state, step=step0)
+
+
+def install_params(cfg: Config, menv: MeshEnv, state: TrainState,
+                   params) -> TrainState:
+    """Install externally produced fp32 params (HF import, params-only
+    restore) into `state`, respecting the optimizer-state layout: under
+    optimizer_offload they become the pinned-host master AND the bf16
+    device compute copy; otherwise they simply replace state.params."""
+    from picotron_tpu.models.llama import compute_dtype
+
+    if not cfg.training.optimizer_offload:
+        shardings = param_shardings(cfg, menv.mesh)
+        return state._replace(
+            params=jax.tree.map(jax.device_put, params, shardings))
+    dev_shardings = param_shardings(cfg, menv.mesh)
+    host_shardings = param_shardings(
+        cfg, menv.mesh, memory_kind=offload_memory_kind(menv.mesh))
+    master = jax.tree.map(
+        lambda p, s: jax.device_put(jnp.asarray(p, jnp.float32), s),
+        params, host_shardings)
+    compute = jax.jit(
+        lambda mp: jax.tree.map(
+            lambda x: x.astype(compute_dtype(cfg.model)), mp),
+        out_shardings=dev_shardings)(master)
+    return state._replace(params=compute,
+                          opt_state=state.opt_state._replace(master=master))
 
 
 def _zero1_spec(spec: P, shape, data_axis_sizes: dict) -> P:

@@ -415,8 +415,12 @@ def pipeline_1f1b_grads(params, ids, tgt, cfg: Config, ctx: ParallelCtx):
     # parallelism the per-tick VJP grads of tp-replicated params (norms)
     # are per-rank partials over this rank's seq shard, hence tp-varying;
     # sync_sp_partial_grads completes them with a tp psum after the scan.
+    # fp32 accumulation regardless of the param dtype: with
+    # optimizer_offload the params (and hence the per-tick VJP grads) are
+    # bf16, and summing n_micro bf16 grads in bf16 would lose the low bits
+    # the fp32 master exists to keep (jnp.add promotes bf16 + fp32 -> fp32).
     g_zero = jax.tree.map(
-        lambda p: _vary_over(jnp.zeros_like(p),
+        lambda p: _vary_over(jnp.zeros(p.shape, jnp.float32),
                              set(_boundary_axes(ctx))
                              | set(jax.typeof(p).vma)),
         params)

@@ -79,6 +79,13 @@ def batch_spec() -> P:
     return P(None, ("dp", "ep"), "cp")
 
 
-def param_shardings(cfg: Config, mesh) -> dict[str, Any]:
-    return jax.tree.map(lambda s: NamedSharding(mesh, s), param_specs(cfg),
+def param_shardings(cfg: Config, mesh,
+                    memory_kind: str | None = None) -> dict[str, Any]:
+    """NamedShardings for every param leaf. `memory_kind='pinned_host'`
+    places the same shards in host RAM — the optimizer-offload home for the
+    fp32 master and Adam moments (each shards exactly like its param, so a
+    multi-chip topology splits the host-resident state across hosts too)."""
+    kw = {} if memory_kind is None else {"memory_kind": memory_kind}
+    return jax.tree.map(lambda s: NamedSharding(mesh, s, **kw),
+                        param_specs(cfg),
                         is_leaf=lambda x: isinstance(x, P))

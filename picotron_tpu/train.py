@@ -24,8 +24,9 @@ from picotron_tpu.config import Config, load_config, num_params
 from picotron_tpu.models.llama import pad_layers_for_pp
 from picotron_tpu.data import MicroBatchDataLoader
 from picotron_tpu.mesh import MeshEnv, multihost_initialize
-from picotron_tpu.parallel.api import init_sharded_state, make_train_step
-from picotron_tpu.parallel.sharding import param_shardings
+from picotron_tpu.parallel.api import (
+    init_sharded_state, install_params, make_train_step,
+)
 from picotron_tpu.train_step import TrainState
 from picotron_tpu.utils import (
     StepTimer, device_memory_gb, device_peak_flops, human_format,
@@ -47,10 +48,9 @@ def build_state(cfg: Config, menv: MeshEnv) \
         params = load_hf_safetensors(cfg.checkpoint.init_from_hf, cfg.model)
         params = pad_layers_for_pp(params, cfg.model.num_hidden_layers,
                                    cfg.distributed.pp_size)
-        shardings = param_shardings(cfg, menv.mesh)
-        params = jax.tree.map(jax.device_put, params, shardings)
-        state = TrainState(params=params, opt_state=state.opt_state,
-                           step=state.step)
+        # install_params respects the optimizer-offload layout (pinned-host
+        # master + bf16 device copy) as well as the standard fp32 layout
+        state = install_params(cfg, menv, state, params)
         log_print(f"initialized weights from {cfg.checkpoint.init_from_hf}")
 
     load_dir = cfg.checkpoint.load_path

@@ -50,6 +50,12 @@ def pytest_configure(config):
         "markers",
         "tpu: on-hardware kernel regression tests (run `pytest -m tpu` on "
         "a machine with a real TPU; skipped/deselected otherwise)")
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process integration and heavy layout-parity compiles. "
+        "Dev loop: `pytest -m 'not slow'` (< 10 min); CI/full: plain "
+        "`pytest tests/` runs everything — semantics identical, the marker "
+        "only partitions wall-time")
 
 
 def pytest_collection_modifyitems(config, items):

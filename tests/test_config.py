@@ -94,7 +94,9 @@ def test_ce_chunk_and_lr_ratio_validation():
     with pytest.raises(ValueError, match="divide"):
         Config(training=TrainingConfig(ce_chunk_size=100)).validate()
     Config(training=TrainingConfig(ce_chunk_size=64)).validate()  # 256 % 64
-    # chunk >= vocab shard is a harmless no-op request
-    Config(training=TrainingConfig(ce_chunk_size=512)).validate()
+    # chunk >= vocab shard would silently degenerate to the fused CE path —
+    # the exact fallback the user set the knob to avoid (ADVICE r3)
+    with pytest.raises(ValueError, match="smaller"):
+        Config(training=TrainingConfig(ce_chunk_size=512)).validate()
     with pytest.raises(ValueError, match="lr_min_ratio"):
         Config(training=TrainingConfig(lr_min_ratio=-0.1)).validate()

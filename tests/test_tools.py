@@ -96,6 +96,71 @@ def test_job_status_machine(tmp_path):
     assert job.classify(returncode=0) == "completed"
 
 
+def test_slurm_render_golden(tmp_path):
+    """The sbatch branch's render (ref: submit_slurm_jobs.py:68-103): the
+    script must carry the exact #SBATCH directives, the status.txt state
+    transitions, and grep alternations built from the SAME pattern
+    constants the local launcher classifies with."""
+    sj = load_tool("submit_jobs")
+    run = tmp_path / "llama-dp8"
+    run.mkdir()
+    (run / "config.json").write_text("{}")
+    job = sj.discover_jobs(str(tmp_path))[0]
+
+    script = sj.render_slurm(job, nodes=4, time_limit="03:30:00")
+    assert script == str(run / "job.slurm")
+    text = open(script).read()
+    expected = sj.SLURM_TEMPLATE.format(
+        name="llama-dp8", nodes=4, run_dir=str(run),
+        time_limit="03:30:00", repo_root=sj.REPO_ROOT,
+        oom_re="|".join(sj.OOM_PATTERNS),
+        timeout_re="|".join(sj.TIMEOUT_PATTERNS))
+    assert text == expected
+    # structural invariants a template edit must not silently break
+    assert "#SBATCH --job-name=llama-dp8" in text
+    assert "#SBATCH --nodes=4" in text
+    assert "#SBATCH --time=03:30:00" in text
+    assert f"srun python -m picotron_tpu.train --config {run}/config.json" \
+        in text
+    for state in ("running", "completed", "oom", "timeout", "fail"):
+        assert f"echo {state} > " in text
+    assert "RESOURCE_EXHAUSTED|Out of memory|OutOfMemoryError" in text
+
+
+def test_slurm_dry_run_renders_without_submitting(tmp_path, capsys,
+                                                  monkeypatch):
+    """--dry-run must render + print the script, call NO sbatch, and leave
+    status.txt untouched (VERDICT r3: the sbatch branch had never executed,
+    not even render-only)."""
+    import subprocess as sp
+
+    sj = load_tool("submit_jobs")
+    run = tmp_path / "run_a"
+    run.mkdir()
+    (run / "config.json").write_text("{}")
+
+    def boom(*a, **k):
+        raise AssertionError("dry run must not invoke subprocess")
+
+    monkeypatch.setattr(sp, "run", boom)
+    monkeypatch.setattr(
+        sys, "argv",
+        ["submit_jobs", str(tmp_path), "--launcher", "slurm", "--dry-run"])
+    sj.main()
+    out = capsys.readouterr().out
+    assert "rendered" in out and "srun python -m picotron_tpu.train" in out
+    assert (run / "job.slurm").exists()
+    assert (run / "status.txt").read_text().strip() == "init"
+
+
+def test_dry_run_requires_slurm_launcher(tmp_path, monkeypatch):
+    sj = load_tool("submit_jobs")
+    monkeypatch.setattr(
+        sys, "argv", ["submit_jobs", str(tmp_path), "--dry-run"])
+    with pytest.raises(SystemExit):
+        sj.main()
+
+
 def test_extract_metrics_harvests_extras_and_val_loss(tmp_path):
     """The harvester picks up trailing extras (moe_drop_frac) and dedicated
     eval lines from the de-facto log-line API."""

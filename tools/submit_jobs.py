@@ -128,8 +128,11 @@ def run_local(job: Job, timeout: float | None) -> str:
     return status
 
 
-def submit_slurm(job: Job, nodes: int, time_limit: str,
-                 depend_on: str | None) -> str | None:
+def render_slurm(job: Job, nodes: int, time_limit: str) -> str:
+    """Render the job's batch script to <run_dir>/job.slurm and return the
+    path (ref: submit_slurm_jobs.py:68-103 renders from its jinja template
+    the same way; here the grep alternations come from the exact pattern
+    constants the local launcher classifies with)."""
     script = os.path.join(job.run_dir, "job.slurm")
     with open(script, "w") as f:
         f.write(SLURM_TEMPLATE.format(
@@ -137,6 +140,12 @@ def submit_slurm(job: Job, nodes: int, time_limit: str,
             time_limit=time_limit, repo_root=REPO_ROOT,
             oom_re="|".join(OOM_PATTERNS),
             timeout_re="|".join(TIMEOUT_PATTERNS)))
+    return script
+
+
+def submit_slurm(job: Job, nodes: int, time_limit: str,
+                 depend_on: str | None) -> str | None:
+    script = render_slurm(job, nodes, time_limit)
     cmd = ["sbatch", "--parsable"]
     if depend_on:
         cmd.append(f"--dependency=afterany:{depend_on}")  # ref: :104-113
@@ -180,7 +189,15 @@ def main() -> None:
                     help="per-job wall-clock limit for the local launcher (s)")
     ap.add_argument("--chain", action="store_true",
                     help="chain slurm jobs with --dependency=afterany")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="slurm launcher only: render each job's batch "
+                         "script to <run_dir>/job.slurm and print it "
+                         "WITHOUT submitting (no sbatch call, status.txt "
+                         "untouched) — inspect exactly what would run")
     args = ap.parse_args()
+    if args.dry_run and args.launcher != "slurm":
+        ap.error("--dry-run renders sbatch scripts; use with "
+                 "--launcher slurm")
 
     jobs = discover_jobs(args.exp_dir)
     if not jobs:
@@ -201,6 +218,11 @@ def main() -> None:
     for job in jobs:
         if args.launcher == "local":
             run_local(job, args.job_timeout)
+        elif args.dry_run:
+            script = render_slurm(job, args.nodes, args.time_limit)
+            print(f"  {job.name}: rendered {script}")
+            with open(script) as f:
+                print("    | " + f.read().rstrip().replace("\n", "\n    | "))
         else:
             new_id = submit_slurm(job, args.nodes, args.time_limit,
                                   prev_id if args.chain else None)
