@@ -155,7 +155,7 @@ def main() -> None:
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--no-remat", action="store_true")
     ap.add_argument("--remat-policy", default=None,
-                    choices=["full", "dots", "dots_attn", "dots_norms"])
+                    choices=["full", "dots", "dots_attn", "dots_norms", "dots_offload"])
     ap.add_argument("--ce-chunk", type=int, default=0,
                     help="stream the LM-head CE over vocab chunks of this "
                          "size (0 = fused): ~tokens*vocab*2B less peak HBM "
@@ -228,10 +228,15 @@ def main() -> None:
         return
 
     # Flag resolution: the bare default is the full-depth headline config
-    # (offload + mbs 2 x ga 64 + full remat). Asking for a depth-reduced
-    # variant (--layers) opts out of offload; everything else fills in the
-    # per-mode defaults.
-    if args.model == "SmolLM-1.7B" and args.layers is None \
+    # (offload + mbs 2 x ga 64 + full remat). ANY explicit shape/policy
+    # flag opts out of the auto-config (an old invocation like
+    # `bench.py --mbs 5` must keep meaning the depth-reduced proxy, not
+    # silently become a 24L run that OOMs); --optimizer-offload composes
+    # with explicit flags as requested.
+    no_shape_flags = (args.layers is None and args.mbs is None
+                      and args.grad_acc is None
+                      and args.remat_policy is None)
+    if args.model == "SmolLM-1.7B" and no_shape_flags \
             and not args.optimizer_offload:
         args.optimizer_offload = True
     if args.optimizer_offload:
@@ -240,6 +245,10 @@ def main() -> None:
         args.grad_acc = args.grad_acc or 64
         args.remat_policy = args.remat_policy or "full"
     else:
+        if args.layers is None and args.model == "SmolLM-1.7B":
+            # without offload the full model's state exceeds one chip;
+            # 8 layers is the honest depth-reduced proxy (PERF.md)
+            args.layers = 8
         args.mbs = args.mbs or 5
         args.grad_acc = args.grad_acc or 1
         args.remat_policy = args.remat_policy or "dots"

@@ -222,12 +222,20 @@ class CheckpointManager:
 
 
 def restore_params_only(cfg: Config, ckpt_dir: str,
-                        step: Optional[int] = None):
+                        step: Optional[int] = None, dtype=None):
     """Restore ONLY the canonical [L]-stacked params from a training
     checkpoint onto the first local device — the inference/export path
     (tools/generate.py, tools/export_hf.py). Skips the Adam moments
     entirely (a partial PyTree restore: ~1/3 the IO and host memory of a
-    full-state restore at 7B scale) and unpads the PP layer stack."""
+    full-state restore at 7B scale) and unpads the PP layer stack.
+
+    `dtype` overrides the restored leaf dtype (Orbax casts DURING restore,
+    so e.g. dtype=jnp.bfloat16 loads a 7B checkpoint in 13.5 GB without
+    the 28 GB fp32 tree ever materializing — the single-chip decode path).
+    For an optimizer_offload checkpoint the "params" entry is only the
+    bf16 compute copy, so this restores the fp32 MASTER from
+    opt_state.master instead — tools/export_hf.py must export full
+    master precision, not bf16-rounded weights (code review r4)."""
     import orbax.checkpoint as ocp
 
     from picotron_tpu.mesh import MeshEnv
@@ -247,16 +255,23 @@ def restore_params_only(cfg: Config, ckpt_dir: str,
                                   nl, pp))
     sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
     restore_args = jax.tree.map(
-        lambda x: ocp.ArrayRestoreArgs(dtype=x.dtype, sharding=sharding),
+        lambda x: ocp.ArrayRestoreArgs(dtype=dtype or x.dtype,
+                                       sharding=sharding),
         abstract)
+    if cfg.training.optimizer_offload:
+        item = {"opt_state": {"master": abstract}}
+        rargs = {"opt_state": {"master": restore_args}}
+        pick = lambda r: r["opt_state"]["master"]  # noqa: E731
+    else:
+        item = {"params": abstract}
+        rargs = {"params": restore_args}
+        pick = lambda r: r["params"]  # noqa: E731
     with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
         restored = ckptr.restore(
             os.path.join(mgr.directory, f"step_{step:08d}", "state"),
             args=ocp.args.PyTreeRestore(
-                item={"params": abstract},
-                restore_args={"params": restore_args},
-                partial_restore=True))
-    return unpad_layers(restored["params"], nl, pp), step
+                item=item, restore_args=rargs, partial_restore=True))
+    return unpad_layers(pick(restored), nl, pp), step
 
 
 # ---------------------------------------------------------------------------

@@ -187,6 +187,51 @@ def resolve_hf_name(name: str) -> str:
     return _PRESET_ALIASES.get(name, name)
 
 
+def model_config_from_hf_json(path_or_dict) -> dict[str, Any]:
+    """ModelConfig kwargs from a local HF `config.json` — the OFFLINE
+    equivalent of the reference's network AutoConfig fetch
+    (ref: create_config.py:51-55): any Llama/Qwen2/Mixtral-family model
+    outside the preset registry resolves from its config file instead of
+    hand-typed hyperparameters. Pass a path or an already-parsed dict."""
+    if isinstance(path_or_dict, dict):
+        hf = path_or_dict
+    else:
+        with open(path_or_dict) as f:
+            hf = json.load(f)
+
+    mtype = hf.get("model_type", "llama")
+    supported = ("llama", "mistral", "mixtral", "qwen2")
+    if mtype not in supported:
+        raise ValueError(
+            f"model_type {mtype!r} is not a supported architecture family "
+            f"({supported}); the model layer (models/llama.py) implements "
+            "the Llama lineage")
+
+    heads = hf["num_attention_heads"]
+    out: dict[str, Any] = {
+        "vocab_size": hf["vocab_size"],
+        "hidden_size": hf["hidden_size"],
+        "intermediate_size": hf["intermediate_size"],
+        "num_hidden_layers": hf["num_hidden_layers"],
+        "num_attention_heads": heads,
+        "num_key_value_heads": hf.get("num_key_value_heads", heads),
+        "max_position_embeddings": hf.get("max_position_embeddings", 2048),
+        "rope_theta": float(hf.get("rope_theta", 10000.0)),
+        "rms_norm_eps": float(hf.get("rms_norm_eps", 1e-5)),
+        "tie_word_embeddings": bool(hf.get("tie_word_embeddings", False)),
+        # Qwen2 carries qkv bias as attention_bias=absent + model_type;
+        # Llama exposes the flag directly
+        "attention_bias": bool(hf.get("attention_bias",
+                                      mtype == "qwen2")),
+    }
+    if hf.get("rope_scaling"):
+        out["rope_scaling"] = dict(hf["rope_scaling"])
+    if hf.get("num_local_experts"):  # Mixtral-style MoE
+        out["num_experts"] = hf["num_local_experts"]
+        out["num_experts_per_token"] = hf.get("num_experts_per_tok", 2)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Config sections — mirror the reference JSON sections one-to-one.
 # ---------------------------------------------------------------------------
@@ -216,14 +261,20 @@ class DistributedConfig:
     # leaves this as a TODO, ref: utils.py:66): between blocks the residual
     # stream / norms are sharded [*, S/tp, H] and the TP entry/exit
     # collectives become all_gather / reduce_scatter (same bytes as the
-    # psum they replace, tp x less activation memory at layer boundaries,
-    # tp x less pipeline boundary traffic).
+    # psum they replace; tp x less pipeline boundary traffic). Memory: the
+    # tp x shrink applies only to the norm/residual tensors BETWEEN g and
+    # f — measured ~5% of total activation memory with remat off and ~0
+    # under the dots remat policies, whose saved dot outputs sit after the
+    # gather and stay full-sequence (tools/memcheck.py --override, PERF.md
+    # round 4). Use it for the boundary traffic, not as a memory lever.
     sequence_parallel: bool = False
     # ZeRO-1 optimizer-state sharding (beyond the reference): shards the
     # Adam moments over 'dp' in addition to their param's tp/pp/ep
     # sharding. GSPMD turns the sharding annotation into the per-shard
-    # update + all-gather schedule; with bf16 moments this cuts resident
-    # optimizer memory by ~dp_size.
+    # update + all-gather schedule, cutting resident moment memory by
+    # ~dp_size — measured on SmolLM-1.7B dp8: 13.5 -> 1.69 GiB/device of
+    # moments, 20.25 -> 8.44 GiB/device total state (tools/memcheck.py
+    # --override distributed.zero1=true; PERF.md round 4).
     zero1: bool = False
     # Accepted for reference-JSON compatibility; ignored (XLA picks transport).
     backend: str = "jax"
@@ -528,10 +579,11 @@ class Config:
             if m.expert_ffn_size % d.tp_size != 0:
                 raise ValueError(
                     "expert ffn size must be divisible by tp_size")
-        if t.remat_policy not in ("full", "dots", "dots_attn", "dots_norms"):
+        if t.remat_policy not in ("full", "dots", "dots_attn", "dots_norms",
+                                  "dots_offload"):
             raise ValueError(
-                f"remat_policy must be 'full', 'dots', 'dots_attn', or "
-                f"'dots_norms', got {t.remat_policy!r}")
+                f"remat_policy must be 'full', 'dots', 'dots_attn', "
+                f"'dots_norms', or 'dots_offload', got {t.remat_policy!r}")
         if t.adam_moments_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"adam_moments_dtype must be 'float32' or 'bfloat16', got "

@@ -30,6 +30,7 @@ restructured for a single-controller SPMD runtime:
 from __future__ import annotations
 
 import itertools
+import os
 import queue as queue_mod
 import threading
 from typing import Any, Iterator, Optional
@@ -145,7 +146,11 @@ class DatasetSource:
     """Adapter over a chunked HF dataset (rows of {"input_ids": [block]})."""
 
     def __init__(self, dataset, shuffle_seed: Optional[int] = None):
-        self.dataset = dataset
+        # numpy output format: ds[start:n] then yields one ndarray slice of
+        # the arrow buffer instead of nested Python lists — measured ~20x
+        # faster get_rows (tools/data_bench.py); the format survives
+        # .shuffle()/.flatten_indices() epoch views.
+        self.dataset = dataset.with_format("numpy", columns=["input_ids"])
         self.shuffle_seed = shuffle_seed
         self._epoch_cache: tuple[int, Any] | None = None
 
@@ -158,7 +163,14 @@ class DatasetSource:
         ds = self.dataset
         if self.shuffle_seed is not None:
             # New permutation each epoch (the role of DistributedSampler's
-            # set_epoch, ref: data.py:131).
+            # set_epoch, ref: data.py:131). Deliberately the LAZY shuffle:
+            # adding .flatten_indices() was measured (tools/data_bench.py)
+            # as a ~6x READ pessimization at cache-resident scale — the
+            # re-materialized arrow table slices worse than the indices
+            # indirection — while the lazy path reads 37M tokens/s,
+            # ~185x an 8-chip host's consumption. Revisit only if a
+            # disk-bound corpus (dataset >> RAM) shows the random-read
+            # cliff the indirection theoretically implies.
             ds = ds.shuffle(seed=self.shuffle_seed + epoch)
         self._epoch_cache = (epoch, ds)
         return ds
@@ -265,11 +277,43 @@ class MicroBatchDataLoader:
                 num_samples=self.cfg.training.num_samples,
             )
         import datasets  # HF; lazy so synthetic paths never import it
+
+        if os.path.isdir(d.name):
+            # File-backed corpus (datasets.save_to_disk layout): either a
+            # PRE-CHUNKED table of {"input_ids": [seq+1]} rows (tokenize
+            # once offline, train many times — the zero-egress path; also
+            # what the 2-process data-determinism test feeds) or raw text
+            # to tokenize here.
+            ds = datasets.load_from_disk(d.name)
+            if isinstance(ds, datasets.DatasetDict):
+                # saving a loaded dataset without selecting a split yields
+                # a DatasetDict; pick the configured split (its
+                # column_names is a per-split dict, so falling through
+                # would crash confusingly in the tokenizer path)
+                if d.split not in ds:
+                    raise ValueError(
+                        f"dataset dir {d.name} holds splits "
+                        f"{sorted(ds)}; dataset.split={d.split!r} is not "
+                        "one of them")
+                ds = ds[d.split]
+            if "input_ids" in ds.column_names:
+                block = len(ds[0]["input_ids"])
+                if block != self.seq_length + 1:
+                    raise ValueError(
+                        f"pre-chunked dataset at {d.name} has blocks of "
+                        f"{block} tokens; training.seq_length="
+                        f"{self.seq_length} needs {self.seq_length + 1} "
+                        f"(input/target shift) — re-chunk the corpus")
+                return DatasetSource(ds,
+                                     shuffle_seed=self.cfg.training.seed)
+            raw = ds
+        else:
+            raw = datasets.load_dataset(d.name, d.subset_name,
+                                        split=d.split)
         from transformers import AutoTokenizer
 
         tokenizer = AutoTokenizer.from_pretrained(
             d.tokenizer_name or self.cfg.model.name)
-        raw = datasets.load_dataset(d.name, d.subset_name, split=d.split)
         chunked = tokenize_and_chunk(
             raw, tokenizer, self.seq_length, d.text_column, d.num_proc)
         return DatasetSource(chunked, shuffle_seed=self.cfg.training.seed)

@@ -348,13 +348,13 @@ def _mlp_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx):
     dt = x.dtype
     h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
     h = ctx.f(h)
-    gate = h @ lp["gate"].astype(dt)
-    up = h @ lp["up"].astype(dt)
+    gate = checkpoint_name(h @ lp["gate"].astype(dt), "mlp_gate")
+    up = checkpoint_name(h @ lp["up"].astype(dt), "mlp_up")
     out = (jax.nn.silu(gate) * up) @ lp["down"].astype(dt)
     return ctx.g(out)
 
 
-def _moe_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, is_real):
+def _moe_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, is_real=1.0):
     """RMSNorm -> top-k routed expert SwiGLU bank (beyond the reference;
     ops/moe.py). Returns (out, aux [2])."""
     from picotron_tpu.ops.moe import moe_mlp
@@ -426,6 +426,25 @@ def remat_policy_for(name: str):
         # on one v5e chip (PERF.md round 4).
         return jax.checkpoint_policies.save_only_these_names(
             "attn_out", "attn_lse", "qkv_out", "attn_proj_out")
+    if name == "dots_offload":
+        # "dots" memory shape with the saved activations parked in pinned
+        # HOST memory instead of HBM (offloaded on the forward, fetched in
+        # backward): near-zero device activation residency for 2x the
+        # activation bytes over PCIe per microbatch. Measured on v5e in
+        # PERF.md round 4 — the PCIe cost exceeds the recompute it avoids
+        # at these shapes; kept as a knob for shapes where it flips
+        # (long-sequence activations >> PCIe budget is the wrong side; big
+        # grad-accum with small activations the right one).
+        # attn_lse stays device-saved: it is tiny ([B,H,S] vs the [B,S,H*D]
+        # tensors) and offloading it crashes libtpu's host-offload
+        # legalizer (host_offload_utils.cc "reduce has 2 operands" check —
+        # the lse feeds a variadic reduce in the flash VJP)
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=["attn_lse"],
+            names_which_can_be_offloaded=[
+                "attn_out", "qkv_out", "attn_proj_out",
+                "mlp_gate", "mlp_up"],
+            offload_src="device", offload_dst="pinned_host")
     return None
 
 

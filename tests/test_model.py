@@ -303,6 +303,7 @@ def test_qwen2_style_bias_tied_structure_and_training():
     assert logits.shape == (4, 32, cfg.model.vocab_size)
 
 
+@pytest.mark.slow
 def test_qwen2_style_layouts_match_single_device():
     """Tied+bias model under dp*tp (vocab-sharded tied head: the embedding
     shard transposes into the head shard) and pp (gated last-stage scoring

@@ -142,6 +142,7 @@ def global_batch(cfg, key=0):
     dict(ep_size=2, pp_size=2, pp_engine="afab"),
     dict(ep_size=2, cp_size=2),
 ])
+@pytest.mark.slow
 def test_moe_layouts_match_single_device(dist):
     cfg = moe_cfg(**dist)
     cfg.validate()
@@ -177,6 +178,7 @@ def test_moe_layouts_match_single_device(dist):
     np.testing.assert_allclose(par_losses, ref_losses, rtol=rtol, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_zero1_with_ep_shards_moments_over_both_data_axes():
     """ZeRO-1 under expert parallelism: non-expert moments shard over the
     fused ('dp','ep') data axes; expert-bank moments (already ep-sharded)
@@ -270,6 +272,7 @@ def test_moe_drop_frac_metric_surfaces_in_step_and_log_line():
     assert "moe_drop_frac" in line
 
 
+@pytest.mark.slow
 def test_moe_padded_pp_slots_contribute_no_router_stats():
     """Uneven layer/pp splits pad the stack with zero layers; a padded
     slot's all-zero router must contribute NO z-loss, balance loss, or

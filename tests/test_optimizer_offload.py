@@ -104,6 +104,7 @@ def test_abstract_state_matches_concrete():
         assert c.shape == a.shape and c.dtype == a.dtype
 
 
+@pytest.mark.slow
 def test_loss_parity_with_baseline():
     """Offload must track the fp32-master baseline: identical first step
     (same bf16 forward), then drift bounded by the bf16 per-microbatch
@@ -215,6 +216,7 @@ def test_streamed_update_structure(monkeypatch):
     assert compute["big"].dtype == jnp.bfloat16
 
 
+@pytest.mark.slow
 def test_checkpoint_roundtrip(tmp_path):
     from picotron_tpu.checkpoint import CheckpointManager
 
@@ -243,6 +245,33 @@ def test_checkpoint_roundtrip(tmp_path):
     step = make_train_step(cfg, menv)
     _, m = step(restored, batch)
     assert np.isfinite(float(m["loss"]))
+
+
+def test_restore_params_only_returns_fp32_master(tmp_path):
+    """export/decode restores from an offload checkpoint must get the fp32
+    MASTER, not the bf16 compute copy — exporting bf16-rounded weights in
+    fp32 containers would be a silent permanent precision loss (code
+    review r4)."""
+    from picotron_tpu.checkpoint import CheckpointManager, restore_params_only
+
+    cfg = offload_cfg()
+    cfg = dataclasses.replace(
+        cfg, checkpoint=dataclasses.replace(cfg.checkpoint,
+                                            save_dir=str(tmp_path),
+                                            async_save=False))
+    _, state, menv = run_steps(cfg, steps=1)
+    CheckpointManager(cfg, menv).save(state)
+
+    params, step = restore_params_only(cfg, str(tmp_path))
+    ref = jax.tree.leaves(state.opt_state.master)[0]
+    got = jax.tree.leaves(params)[0]
+    assert got.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    # the master is NOT representable in bf16 after one update — restoring
+    # the compute copy instead would fail this
+    assert not np.array_equal(
+        np.asarray(ref),
+        np.asarray(ref.astype(jnp.bfloat16).astype(jnp.float32)))
 
 
 def test_install_params_fills_master_and_compute():

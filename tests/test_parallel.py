@@ -120,6 +120,7 @@ def run_single(cfg_parallel, steps=3):
     dict(pp_size=2, tp_size=2, sequence_parallel=True),
     dict(pp_size=2, tp_size=2, sequence_parallel=True, pp_engine="afab"),
 ])
+@pytest.mark.slow
 def test_layouts_match_single_device(dist):
     cfg = tiny_cfg(**dist)
     par_losses, par_state = run_parallel(cfg)
@@ -228,6 +229,7 @@ def test_vocab_parallel_ce_grad_matches_dense():
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_zero1_moments_sharded_and_parity():
     """ZeRO-1: moments shard over dp; training is numerically identical to
     the unsharded-optimizer run (GSPMD inserts the per-shard update +
@@ -251,6 +253,31 @@ def test_zero1_moments_sharded_and_parity():
     assert all("dp" in flat_axes(s) for s in moment_specs), moment_specs
 
 
+def test_zero1_moment_footprint_shrinks_dp_fold():
+    """The claimed ~dp_size x cut in resident optimizer-state memory
+    (config.py zero1 docstring, measured at scale in PERF.md r4), asserted
+    structurally on the virtual mesh: per-device moment bytes under zero1
+    must be ~1/dp of the unsharded layout (abstract state — no arrays
+    materialize)."""
+    from picotron_tpu.parallel.api import init_sharded_state
+
+    def per_device_opt_bytes(cfg):
+        menv = MeshEnv.from_config(cfg)
+        st = init_sharded_state(cfg, menv, jax.random.key(0), abstract=True)
+        total = 0
+        for leaf in jax.tree.leaves(st.opt_state):
+            shard = leaf.sharding.shard_shape(leaf.shape)
+            total += int(np.prod(shard)) * leaf.dtype.itemsize
+        return total
+
+    base = per_device_opt_bytes(tiny_cfg(dp_size=4))
+    z1 = per_device_opt_bytes(tiny_cfg(dp_size=4, zero1=True))
+    # small non-divisible leaves (norms) stay replicated, so slightly
+    # above exactly 4x; anything < 3x would mean the annotation regressed
+    assert base / z1 > 3.0, (base, z1)
+
+
+@pytest.mark.slow
 def test_ce_chunking_matches_fused_across_layouts():
     """ce_chunk_size streams the LM-head CE over vocab chunks without
     materializing [tokens, vocab] logits; it must match the fused path to

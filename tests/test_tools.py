@@ -96,6 +96,57 @@ def test_job_status_machine(tmp_path):
     assert job.classify(returncode=0) == "completed"
 
 
+def test_create_config_from_hf_config_json(tmp_path):
+    """--from-hf-config: the offline AutoConfig (VERDICT r3 missing #1) —
+    a non-preset Llama-family model resolves from its local config.json;
+    Qwen2 model_type implies qkv bias; Mixtral fields map to the MoE
+    knobs; unsupported architectures are rejected."""
+    import json
+
+    hf = {
+        "model_type": "qwen2", "vocab_size": 1024, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "max_position_embeddings": 512, "rope_theta": 1e6,
+        "rms_norm_eps": 1e-6, "tie_word_embeddings": True,
+    }
+    p = tmp_path / "config.json"
+    p.write_text(json.dumps(hf))
+
+    cc = load_tool("create_config")
+    args = cc.build_parser().parse_args([
+        "--exp-name", "custom", "--out-dir", str(tmp_path),
+        "--model", "my-custom-model", "--from-hf-config", str(p),
+        "--dp", "2", "--seq-len", "64", "--mbs", "1", "--grad-acc", "1",
+        "--use-cpu",
+    ])
+    path = cc.create_single_config(args)
+    from picotron_tpu.config import load_config, model_config_from_hf_json
+    cfg = load_config(path)
+    assert cfg.model.vocab_size == 1024
+    assert cfg.model.num_hidden_layers == 2
+    assert cfg.model.rope_theta == 1e6
+    assert cfg.model.attention_bias is True  # qwen2 implies qkv bias
+    assert cfg.model.tie_word_embeddings is True
+    cfg.validate()
+
+    moe = model_config_from_hf_json({
+        "model_type": "mixtral", "vocab_size": 512, "hidden_size": 32,
+        "intermediate_size": 64, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_local_experts": 8,
+        "num_experts_per_tok": 2,
+    })
+    assert moe["num_experts"] == 8 and moe["num_experts_per_token"] == 2
+    assert moe["attention_bias"] is False
+
+    with pytest.raises(ValueError, match="not a supported"):
+        model_config_from_hf_json({"model_type": "gpt_bigcode",
+                                   "vocab_size": 1, "hidden_size": 1,
+                                   "intermediate_size": 1,
+                                   "num_hidden_layers": 1,
+                                   "num_attention_heads": 1})
+
+
 def test_slurm_render_golden(tmp_path):
     """The sbatch branch's render (ref: submit_slurm_jobs.py:68-103): the
     script must carry the exact #SBATCH directives, the status.txt state
@@ -151,6 +202,46 @@ def test_slurm_dry_run_renders_without_submitting(tmp_path, capsys,
     assert "rendered" in out and "srun python -m picotron_tpu.train" in out
     assert (run / "job.slurm").exists()
     assert (run / "status.txt").read_text().strip() == "init"
+
+
+def test_watch_queue_flips_pending_to_running_and_catches_dead(tmp_path,
+                                                               monkeypatch):
+    """The squeue poller (ref: base_job.slurm:16-32): PENDING -> RUNNING
+    when SLURM starts the job; a job that leaves the queue while still
+    'pending' (killed before its script's first line) is marked fail
+    instead of dangling forever."""
+    import subprocess as sp
+
+    sj = load_tool("submit_jobs")
+    for name in ("run_a", "run_b"):
+        d = tmp_path / name
+        d.mkdir()
+        (d / "config.json").write_text("{}")
+    job_a, job_b = sj.discover_jobs(str(tmp_path))
+    job_a.set_status("pending")
+    job_b.set_status("pending")
+
+    # poll 1: a PENDING, b RUNNING; poll 2: a gone (never started), b gone
+    polls = iter([
+        "1001 PENDING\n1002 RUNNING\n",
+        "",
+    ])
+
+    class R:
+        def __init__(self, out):
+            self.stdout = out
+            self.returncode = 0
+
+    def fake_run(cmd, **kw):
+        assert cmd[0] == "squeue"
+        return R(next(polls))
+
+    monkeypatch.setattr(sp, "run", fake_run)
+    monkeypatch.setattr(sj.time, "sleep", lambda s: None)
+    sj.watch_queue(str(tmp_path), {"run_a": "1001", "run_b": "1002"},
+                   interval=0, max_polls=2)
+    assert job_a.status == "fail"      # left queue while pending
+    assert job_b.status == "running"   # started; epilogue owns the rest
 
 
 def test_dry_run_requires_slurm_launcher(tmp_path, monkeypatch):

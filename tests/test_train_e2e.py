@@ -11,6 +11,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # integration tier: run with plain `pytest tests/`; dev loop = -m 'not slow'
+
 sys.path.insert(0, "tools")
 
 from picotron_tpu import train  # noqa: E402

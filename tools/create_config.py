@@ -44,6 +44,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ZeRO-1: shard Adam moments over dp")
     # model
     p.add_argument("--model", default="HuggingFaceTB/SmolLM-1.7B")
+    p.add_argument("--from-hf-config", default=None, metavar="CONFIG_JSON",
+                   help="resolve model hyperparameters from a local HF "
+                        "config.json instead of the preset registry — the "
+                        "offline AutoConfig: any Llama/Qwen2/Mixtral-"
+                        "family model trains without hand-typing its "
+                        "architecture (--model then only names the run)")
     p.add_argument("--num-hidden-layers", type=int, default=None,
                    help="override the preset's layer count "
                         "(ref: create_config.py:56-59)")
@@ -104,7 +110,16 @@ def create_single_config(args) -> str:
             num_key_value_heads=args.num_key_value_heads,
         ).items() if v is not None
     }
-    preset = resolve_preset(args.model)
+    if getattr(args, "from_hf_config", None):
+        # offline long-tail resolution: any Llama-family model outside the
+        # preset registry, from its local HF config.json (the reference
+        # fetches this over the network via AutoConfig,
+        # ref: create_config.py:51-55; zero-egress pods can't)
+        from picotron_tpu.config import model_config_from_hf_json
+
+        preset = model_config_from_hf_json(args.from_hf_config)
+    else:
+        preset = resolve_preset(args.model)
     seq_len = args.seq_len
     if seq_len > preset["max_position_embeddings"]:
         preset["max_position_embeddings"] = seq_len

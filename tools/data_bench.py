@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Host data-pipeline microbench: does the loader outpace the chips?
+
+The device side consumes ~7k tokens/s/chip at the full-depth headline (up
+to ~25k on the depth-reduced config), i.e. ~56-200k tokens/s for an
+8-chip host. This benchmarks the HOST side of the pipeline on a real
+on-disk HF-datasets arrow table (built locally — zero egress):
+
+1. epoch-view construction cost (`ds.shuffle(seed).flatten_indices()` as
+   the alternative under test),
+2. steady-state `DatasetSource.get_rows` + numpy assembly throughput:
+   shuffled-lazy (production) vs shuffled+flatten_indices vs unshuffled —
+   the numbers behind DatasetSource's choice to keep the lazy shuffle,
+3. `tokenize_and_chunk`'s map+pack throughput with a stand-in tokenizer
+   (zero egress: no real BPE vocab on disk; the stand-in hashes whitespace
+   words — the point is the pipeline around the tokenizer, which is
+   one-time preprocessing anyway, not the tokenizer itself).
+
+Usage: python tools/data_bench.py [--blocks 20000] [--seq 2048]
+Prints one human-readable line per measurement plus a JSON summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def build_chunked_dataset(path: str, blocks: int, seq: int):
+    import datasets
+
+    rng = np.random.default_rng(0)
+    rows = rng.integers(0, 50257, (blocks, seq + 1), dtype=np.int32)
+    ds = datasets.Dataset.from_dict({"input_ids": rows.tolist()})
+    ds.save_to_disk(path)
+    return datasets.load_from_disk(path)  # memory-mapped arrow, like prod
+
+
+def bench_get_rows(source, blocks: int, seq: int, label: str,
+                   batch_rows: int = 64) -> float:
+    from picotron_tpu.data import DatasetSource  # noqa: F401 (doc link)
+
+    t0 = time.perf_counter()
+    total = 0
+    start = 0
+    while start + batch_rows <= blocks:
+        rows = source.get_rows(0, start, batch_rows)
+        total += rows.size
+        start += batch_rows
+    dt = time.perf_counter() - t0
+    rate = total / dt
+    print(f"{label}: {rate/1e6:.1f}M tokens/s "
+          f"({total/1e6:.1f}M tokens in {dt:.2f}s)")
+    return rate
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--blocks", type=int, default=20000)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the generated dataset dir")
+    args = ap.parse_args()
+
+    from picotron_tpu.data import DatasetSource, tokenize_and_chunk
+
+    tmp = tempfile.mkdtemp(prefix="data_bench_")
+    out = {}
+    try:
+        ds = build_chunked_dataset(os.path.join(tmp, "chunked"),
+                                   args.blocks, args.seq)
+
+        # 1. once-per-epoch view construction
+        t0 = time.perf_counter()
+        flat = ds.shuffle(seed=1).flatten_indices()
+        out["epoch_view_s"] = time.perf_counter() - t0
+        print(f"epoch view (shuffle+flatten_indices, {args.blocks} blocks): "
+              f"{out['epoch_view_s']:.2f}s")
+        del flat
+
+        # 2. steady-state read throughput: the production path (lazy
+        # shuffle) vs the flatten_indices alternative vs unshuffled.
+        # flatten_indices was VERDICT r3's suggested fix for the lazy
+        # indices mapping's theoretical random-read cliff; measurement
+        # showed the OPPOSITE at cache-resident scale (see DatasetSource).
+        out["read_lazy_tok_s"] = bench_get_rows(
+            DatasetSource(ds, shuffle_seed=1), args.blocks, args.seq,
+            "get_rows shuffled lazy (production)")
+
+        class FlatSource(DatasetSource):
+            def _epoch_view(self, epoch):
+                if self._epoch_cache and self._epoch_cache[0] == epoch:
+                    return self._epoch_cache[1]
+                v = self.dataset.shuffle(
+                    seed=self.shuffle_seed + epoch).flatten_indices()
+                self._epoch_cache = (epoch, v)
+                return v
+
+        out["read_flat_tok_s"] = bench_get_rows(
+            FlatSource(ds, shuffle_seed=1), args.blocks, args.seq,
+            "get_rows shuffled+flatten_indices")
+        out["read_seq_tok_s"] = bench_get_rows(
+            DatasetSource(ds, shuffle_seed=None), args.blocks, args.seq,
+            "get_rows unshuffled")
+
+        # 3. preprocessing throughput with a stand-in tokenizer
+        import datasets as hfds
+
+        words = [f"w{i:04d}" for i in range(1000)]
+        rng = np.random.default_rng(2)
+        texts = [" ".join(words[j] for j in rng.integers(0, 1000, 256))
+                 for _ in range(2000)]
+        raw = hfds.Dataset.from_dict({"text": texts})
+
+        class StandinTokenizer:
+            def __call__(self, texts):
+                return {"input_ids": [
+                    [hash(w) % 50000 for w in t.split()] for t in texts]}
+
+        t0 = time.perf_counter()
+        chunked = tokenize_and_chunk(raw, StandinTokenizer(), args.seq)
+        dt = time.perf_counter() - t0
+        toks = sum(len(r) for r in chunked["input_ids"])
+        out["preproc_tok_s"] = toks / dt
+        print(f"tokenize_and_chunk (stand-in tokenizer): "
+              f"{out['preproc_tok_s']/1e6:.2f}M tokens/s")
+    finally:
+        if not args.keep:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    # device-side comparison points (PERF.md): full-depth headline ~7k
+    # tok/s/chip, depth-reduced peak ~25k tok/s/chip, 8-chip host ~200k
+    out["vs_8chip_host_margin"] = round(
+        out["read_lazy_tok_s"] / (25_000 * 8), 1)
+    print(json.dumps({k: (round(v, 1) if isinstance(v, float) else v)
+                      for k, v in out.items()}))
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,21 @@ def main() -> None:
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--top-k", type=int, default=0)
     ap.add_argument("--seed", type=int, default=0)
+    # Memory envelope at target scale (a 7B checkpoint): fp32 params are
+    # 28 GB and cannot decode on one 16 GB chip. --load-dtype bfloat16
+    # restores straight into 13.5 GB (Orbax casts during restore; decode
+    # computes in bf16 regardless, so outputs are unchanged); --tp N
+    # additionally shards params + KV cache over N chips (~13.5/N GB + a
+    # [L, B, S, Hkv/N, D] cache slice per chip).
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel decode over this many chips "
+                         "(training TP shardings; GSPMD inserts the "
+                         "collectives)")
+    ap.add_argument("--load-dtype", default=None,
+                    choices=["float32", "bfloat16"],
+                    help="dtype to restore checkpoint params in "
+                         "(bfloat16 halves load memory; decode computes "
+                         "bf16 either way)")
     args = ap.parse_args()
 
     from picotron_tpu.config import (
@@ -51,13 +66,16 @@ def main() -> None:
     )
     from picotron_tpu.generate import generate
 
+    load_dtype = (jnp.bfloat16 if args.load_dtype == "bfloat16"
+                  else jnp.float32 if args.load_dtype == "float32" else None)
     if args.hf_dir:
         if not args.model:
             ap.error("--hf-dir needs --model <preset>")
         from picotron_tpu.checkpoint import load_hf_safetensors
 
         cfg_m = ModelConfig(name=args.model, **resolve_preset(args.model))
-        params = load_hf_safetensors(args.hf_dir, cfg_m)
+        params = load_hf_safetensors(args.hf_dir, cfg_m,
+                                     dtype=load_dtype or jnp.float32)
     else:
         if not args.config:
             ap.error("--ckpt-dir needs --config <json>")
@@ -65,7 +83,12 @@ def main() -> None:
         cfg_m = cfg.model
         from picotron_tpu.checkpoint import restore_params_only
 
-        params, _ = restore_params_only(cfg, args.ckpt_dir)
+        params, _ = restore_params_only(cfg, args.ckpt_dir,
+                                        dtype=load_dtype)
+    if args.tp > 1:
+        from picotron_tpu.generate import place_for_decode
+
+        params = place_for_decode(params, cfg_m, tp=args.tp)
 
     tokenizer = None
     if args.prompt is not None:

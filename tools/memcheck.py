@@ -78,10 +78,35 @@ def main() -> None:
     ap.add_argument("--sweep-mbs", type=int, nargs="*", default=None,
                     help="analyze these micro-batch sizes instead of the "
                          "config's")
+    ap.add_argument("--override", nargs="*", default=[],
+                    metavar="SECTION.KEY=VALUE",
+                    help="dotted config overrides applied before analysis "
+                         "(e.g. distributed.zero1=true "
+                         "distributed.sequence_parallel=true) — compare a "
+                         "knob's memory effect without writing config "
+                         "variants")
     args = ap.parse_args()
 
     from picotron_tpu.config import load_config
     from picotron_tpu.mesh import force_host_device_count
+
+    if args.override:
+        import tempfile
+
+        with open(args.config) as f:
+            raw = json.load(f)
+        for ov in args.override:
+            dotted, _, val = ov.partition("=")
+            node = raw
+            *path, key = dotted.split(".")
+            for p in path:
+                node = node.setdefault(p, {})
+            node[key] = json.loads(val)  # true/false/numbers/strings-quoted
+        tmp = tempfile.NamedTemporaryFile(
+            "w", suffix=".json", delete=False)
+        json.dump(raw, tmp)
+        tmp.close()
+        args.config = tmp.name
 
     cfg = load_config(args.config)
     # Simulate the config's topology on host CPUs (backend-init-order

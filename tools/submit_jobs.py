@@ -161,6 +161,52 @@ def submit_slurm(job: Job, nodes: int, time_limit: str,
     return job_id
 
 
+def watch_queue(exp_dir: str, job_ids: dict[str, str], interval: float = 30.0,
+                max_polls: int | None = None) -> None:
+    """Poll squeue and flip each submitted job's status.txt PENDING ->
+    RUNNING the moment SLURM starts it (the reference runs this from a
+    background poller inside the batch script, ref: base_job.slurm:16-32;
+    here it is the submitter's loop, which also covers jobs that die before
+    their script's first line — those leave the queue without ever writing
+    'running', and the poll marks them 'fail'). Returns when every watched
+    job has left the queue."""
+    watched = dict(job_ids)  # name -> slurm job id
+    polls = 0
+    while watched and (max_polls is None or polls < max_polls):
+        out = subprocess.run(
+            ["squeue", "--noheader", "--format=%i %T",
+             "--jobs", ",".join(watched.values())],
+            capture_output=True, text=True)
+        if out.returncode != 0:
+            # transient slurmctld hiccup: an empty answer here must NOT be
+            # read as "every job left the queue" (that would mark pending
+            # jobs fail); skip the poll and retry
+            polls += 1
+            time.sleep(interval)
+            continue
+        states = {}
+        for line in out.stdout.splitlines():
+            parts = line.split()
+            if len(parts) >= 2:
+                states[parts[0]] = parts[1]
+        for name, jid in list(watched.items()):
+            job = Job(os.path.join(exp_dir, name))
+            st = states.get(jid)
+            if st == "RUNNING" and job.status == "pending":
+                job.set_status("running")
+            elif st is None:
+                # left the queue: the script's epilogue normally wrote the
+                # terminal status; a job killed before its first line never
+                # did — 'pending' with no queue entry means it never
+                # started, don't leave it pending forever
+                if job.status == "pending":
+                    job.set_status("fail")
+                del watched[name]
+        polls += 1
+        if watched:
+            time.sleep(interval)
+
+
 def print_table(jobs: list[Job]) -> None:
     """ref: submit_slurm_jobs.py:116-147."""
     counts: dict[str, int] = {}
@@ -194,6 +240,13 @@ def main() -> None:
                          "script to <run_dir>/job.slurm and print it "
                          "WITHOUT submitting (no sbatch call, status.txt "
                          "untouched) — inspect exactly what would run")
+    ap.add_argument("--watch", action="store_true",
+                    help="slurm launcher only: after submitting, poll "
+                         "squeue and flip status.txt pending -> running "
+                         "as jobs start (jobs that die before their first "
+                         "script line are marked fail; ref: "
+                         "base_job.slurm:16-32's background poller)")
+    ap.add_argument("--watch-interval", type=float, default=30.0)
     args = ap.parse_args()
     if args.dry_run and args.launcher != "slurm":
         ap.error("--dry-run renders sbatch scripts; use with "
@@ -215,6 +268,7 @@ def main() -> None:
     print(f"{len(jobs)} job(s) to run")
 
     prev_id = None
+    submitted: dict[str, str] = {}
     for job in jobs:
         if args.launcher == "local":
             run_local(job, args.job_timeout)
@@ -231,7 +285,10 @@ def main() -> None:
                 # jobs stay chained (serialized) rather than all starting
                 # concurrently.
                 prev_id = new_id
+                submitted[job.name] = new_id
 
+    if args.watch and submitted:
+        watch_queue(args.exp_dir, submitted, interval=args.watch_interval)
     print_table(discover_jobs(args.exp_dir))
 
 
