@@ -57,6 +57,11 @@ MODEL_PRESETS: dict[str, dict[str, Any]] = {
         num_hidden_layers=40, num_attention_heads=40, num_key_value_heads=40,
         max_position_embeddings=4096, rope_theta=10000.0, rms_norm_eps=1e-5,
     ),
+    "meta-llama/Llama-2-70b-hf": dict(
+        vocab_size=32000, hidden_size=8192, intermediate_size=28672,
+        num_hidden_layers=80, num_attention_heads=64, num_key_value_heads=8,
+        max_position_embeddings=4096, rope_theta=10000.0, rms_norm_eps=1e-5,
+    ),
     # Llama-3
     "meta-llama/Meta-Llama-3-8B": dict(
         vocab_size=128256, hidden_size=4096, intermediate_size=14336,
@@ -67,6 +72,15 @@ MODEL_PRESETS: dict[str, dict[str, Any]] = {
     "meta-llama/Llama-3.1-8B": dict(
         vocab_size=128256, hidden_size=4096, intermediate_size=14336,
         num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+        max_position_embeddings=131072, rope_theta=500000.0,
+        rms_norm_eps=1e-5,
+        rope_scaling=dict(rope_type="llama3", factor=8.0,
+                          low_freq_factor=1.0, high_freq_factor=4.0,
+                          original_max_position_embeddings=8192),
+    ),
+    "meta-llama/Llama-3.1-70B": dict(
+        vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+        num_hidden_layers=80, num_attention_heads=64, num_key_value_heads=8,
         max_position_embeddings=131072, rope_theta=500000.0,
         rms_norm_eps=1e-5,
         rope_scaling=dict(rope_type="llama3", factor=8.0,
@@ -124,6 +138,12 @@ MODEL_PRESETS: dict[str, dict[str, Any]] = {
         max_position_embeddings=32768, rope_theta=1e6, rms_norm_eps=1e-5,
         num_experts=8, num_experts_per_token=2,
     ),
+    "mistralai/Mixtral-8x22B-v0.1": dict(
+        vocab_size=32768, hidden_size=6144, intermediate_size=16384,
+        num_hidden_layers=56, num_attention_heads=48, num_key_value_heads=8,
+        max_position_embeddings=65536, rope_theta=1e6, rms_norm_eps=1e-5,
+        num_experts=8, num_experts_per_token=2,
+    ),
     # Tiny debug model for tests / CI
     "picotron-tpu/debug-tiny": dict(
         vocab_size=256, hidden_size=64, intermediate_size=128,
@@ -155,8 +175,11 @@ _PRESET_ALIASES = {
     "HuggingFaceTB/SmolLM-1.7B-Instruct": "HuggingFaceTB/SmolLM-1.7B",
     "Llama-2-7B": "meta-llama/Llama-2-7b-hf",
     "Llama-2-13B": "meta-llama/Llama-2-13b-hf",
+    "Llama-2-70B": "meta-llama/Llama-2-70b-hf",
     "Llama-3-8B": "meta-llama/Meta-Llama-3-8B",
     "Llama-3.1-8B": "meta-llama/Llama-3.1-8B",
+    "Llama-3.1-70B": "meta-llama/Llama-3.1-70B",
+    "Mixtral-8x22B": "mistralai/Mixtral-8x22B-v0.1",
     "Llama-3.2-1B": "meta-llama/Llama-3.2-1B",
     "Llama-3.2-3B": "meta-llama/Llama-3.2-3B",
     "TinyLlama-1.1B": "TinyLlama/TinyLlama-1.1B-Chat-v1.0",
@@ -224,6 +247,19 @@ def model_config_from_hf_json(path_or_dict) -> dict[str, Any]:
         "attention_bias": bool(hf.get("attention_bias",
                                       mtype == "qwen2")),
     }
+    act = hf.get("hidden_act", "silu")
+    if act in ("silu", "swish"):
+        out["hidden_act"] = "silu"
+    elif act == "gelu":
+        # transformers' ACT2FN "gelu" is the EXACT erf GELU — mapping it
+        # to the tanh approximation would silently drift logits vs the HF
+        # reference (code review r4)
+        out["hidden_act"] = "gelu"
+    elif act in ("gelu_new", "gelu_pytorch_tanh"):
+        out["hidden_act"] = "gelu_tanh"
+    else:
+        raise ValueError(
+            f"hidden_act {act!r} unsupported (silu/gelu gated MLPs only)")
     if hf.get("rope_scaling"):
         out["rope_scaling"] = dict(hf["rope_scaling"])
     if hf.get("num_local_experts"):  # Mixtral-style MoE
@@ -326,6 +362,12 @@ class ModelConfig:
     # checkpoint.py:88-91 force-creates lm_head).
     attention_bias: bool = False
     tie_word_embeddings: bool = False
+    # Gated-MLP activation: "silu" (Llama/Qwen/Mixtral SwiGLU), "gelu"
+    # (EXACT erf GELU — transformers' "gelu"), or "gelu_tanh" (the tanh
+    # approximation — transformers' "gelu_pytorch_tanh"/"gelu_new",
+    # the Gemma-style GeGLU) — widens the --from-hf-config long tail
+    # beyond pure-SwiGLU families.
+    hidden_act: str = "silu"
     dtype: str = "bfloat16"  # compute/activation dtype; master params are fp32
     # Attention implementation: "auto" picks flash on TPU / reference on CPU;
     # CP > 1 always routes through the ring (ref: model.py:148-158 dispatch).
@@ -389,6 +431,10 @@ class ModelConfig:
             raise ValueError("num_attention_heads must be divisible by num_key_value_heads")
         if self.head_dim % 2 != 0:
             raise ValueError("head_dim must be even for RoPE")
+        if self.hidden_act not in ("silu", "gelu", "gelu_tanh"):
+            raise ValueError(
+                f"hidden_act must be 'silu', 'gelu', or 'gelu_tanh', got "
+                f"{self.hidden_act!r}")
 
 
 @dataclass(frozen=True)
@@ -579,11 +625,12 @@ class Config:
             if m.expert_ffn_size % d.tp_size != 0:
                 raise ValueError(
                     "expert ffn size must be divisible by tp_size")
-        if t.remat_policy not in ("full", "dots", "dots_attn", "dots_norms",
-                                  "dots_offload"):
+        if t.remat_policy not in ("full", "dots", "dots_attn", "dots_lean",
+                                  "dots_norms", "dots_offload"):
             raise ValueError(
                 f"remat_policy must be 'full', 'dots', 'dots_attn', "
-                f"'dots_norms', or 'dots_offload', got {t.remat_policy!r}")
+                f"'dots_lean', 'dots_norms', or 'dots_offload', got "
+                f"{t.remat_policy!r}")
         if t.adam_moments_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"adam_moments_dtype must be 'float32' or 'bfloat16', got "

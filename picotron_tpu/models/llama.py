@@ -30,6 +30,7 @@ TPU-first design decisions (vs the reference's nn.Module tree):
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import Any, Callable, Optional
 
 import jax
@@ -309,6 +310,15 @@ def qkv_proj(h, lp, d: int):
         q = q + lp["b_q"].astype(dt)
         k = k + lp["b_k"].astype(dt)
         v = v + lp["b_v"].astype(dt)
+    # checkpoint-name the FLAT [B, S, H*D] projections, BEFORE the head
+    # reshape: saved activations inherit the flat matmul layout, whose
+    # (8, 128)-tiled minor dim is H*D. Naming the reshaped [B, S, H, 64]
+    # form instead makes the remat policies store tensors whose 64-wide
+    # minor dim tiles to 128 lanes — a 2x HBM pad on every saved q/k/v
+    # (measured ~1.5 GB at SmolLM-1.7B mbs 2; PERF.md r4).
+    q = checkpoint_name(q, "qkv_out")
+    k = checkpoint_name(k, "qkv_out")
+    v = checkpoint_name(v, "qkv_out")
     return (q.reshape(b, s, -1, d), k.reshape(b, s, -1, d),
             v.reshape(b, s, -1, d))
 
@@ -322,12 +332,12 @@ def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
     h = ctx.f(h)  # column-parallel entry: identity fwd / psum bwd; under
     # sequence parallelism an all_gather that restores the full sequence
     b, s, _ = h.shape
+    # qkv_proj checkpoint-names the flat projections ("qkv_out"): the
+    # "dots_attn" policy saves the attention-side dots (the flash VJP's
+    # inputs) while the MLP recomputes — the memory/flops midpoint between
+    # "dots" and "full" (the MLP's gate/up activations are ~2/3 of a
+    # layer's saved bytes but its matmuls only ~+7% of step flops)
     q, k, v = qkv_proj(h, lp, d)
-    # one shared name: the "dots_attn" policy saves the attention-side dots
-    # (the flash VJP's inputs) while the MLP recomputes — the memory/flops
-    # midpoint between "dots" and "full" (the MLP's gate/up activations are
-    # ~2/3 of a layer's saved bytes but its matmuls only ~+7% of step flops)
-    q, k, v = (checkpoint_name(t, "qkv_out") for t in (q, k, v))
     n_q = q.shape[2]
 
     # K/V stay unexpanded (n_kv heads) — attention impls handle GQA so the
@@ -343,14 +353,25 @@ def _attention_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, cos, sin):
     return ctx.g(out)  # row-parallel exit: psum-over-tp fwd / identity bwd
 
 
+def mlp_act(cfg: ModelConfig):
+    """Gated-MLP activation on the gate branch: SwiGLU (silu, the Llama
+    lineage, ref: model.py:184-186), exact-erf GeGLU ("gelu" — what
+    transformers' ACT2FN "gelu" means), or tanh-approx GeGLU ("gelu_tanh",
+    the Gemma-style variant) — shared by the dense MLP, the MoE expert
+    bank, and the decode path so they cannot diverge."""
+    if cfg.hidden_act == "silu":
+        return jax.nn.silu
+    return partial(jax.nn.gelu, approximate=cfg.hidden_act == "gelu_tanh")
+
+
 def _mlp_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx):
-    """RMSNorm -> SwiGLU (ref: model.py:184-186)."""
+    """RMSNorm -> gated MLP (ref: model.py:184-186)."""
     dt = x.dtype
     h = rms_norm(x, lp["post_norm"], cfg.rms_norm_eps)
     h = ctx.f(h)
     gate = checkpoint_name(h @ lp["gate"].astype(dt), "mlp_gate")
     up = checkpoint_name(h @ lp["up"].astype(dt), "mlp_up")
-    out = (jax.nn.silu(gate) * up) @ lp["down"].astype(dt)
+    out = (mlp_act(cfg)(gate) * up) @ lp["down"].astype(dt)
     return ctx.g(out)
 
 
@@ -366,6 +387,7 @@ def _moe_block(x, lp, cfg: ModelConfig, ctx: ParallelCtx, is_real=1.0):
         num_experts=cfg.num_experts,
         top_k=cfg.num_experts_per_token,
         capacity_factor=cfg.capacity_factor,
+        act=mlp_act(cfg),
         ep_axis=ctx.moe_ep_axis,
         router_aux_coef=cfg.router_aux_coef,
         router_z_coef=cfg.router_z_coef,
@@ -418,14 +440,23 @@ def remat_policy_for(name: str):
             jax.checkpoint_policies.save_only_these_names(*names),
         )
     if name == "dots_attn":
-        # Save only the attention-side dots (qkv projections, the flash
-        # kernel's out/lse residuals, the o-projection) and recompute the
-        # MLP in backward: ~2.6x less saved-activation HBM than "dots" for
-        # ~+7% step FLOPs (gate/up matmul recompute) — the policy that fits
-        # full-depth SmolLM-1.7B beside optimizer_offload's fp32 grad tree
-        # on one v5e chip (PERF.md round 4).
+        # Save only the flash kernel's inputs and residuals (qkv
+        # projections, out, lse) and recompute everything else in backward
+        # — the MLP (its gate/up activations are ~2/3 of a layer's saved
+        # bytes but its matmuls only ~+7% of step FLOPs) and the
+        # o-projection (one matmul consuming the SAVED attn_out). The
+        # policy that fits full-depth SmolLM-1.7B beside
+        # optimizer_offload's fp32 grad tree on one v5e chip (PERF.md r4).
         return jax.checkpoint_policies.save_only_these_names(
-            "attn_out", "attn_lse", "qkv_out", "attn_proj_out")
+            "attn_out", "attn_lse", "qkv_out")
+    if name == "dots_lean":
+        # "dots" minus the o-projection and down-projection outputs (each
+        # is one matmul whose inputs ARE saved — attn_out and gate/up —
+        # so recompute costs ~+2% step FLOPs for ~0.4 GB less saved HBM
+        # at SmolLM-1.7B mbs 1). All saves are the flat named forms, so
+        # none carry the 64-lane tile padding (PERF.md r4).
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "attn_lse", "qkv_out", "mlp_gate", "mlp_up")
     if name == "dots_offload":
         # "dots" memory shape with the saved activations parked in pinned
         # HOST memory instead of HBM (offloaded on the forward, fetched in

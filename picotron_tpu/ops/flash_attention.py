@@ -572,15 +572,21 @@ def _flash_core_fwd(q4, k4, v4, qpos, kpos, rope, sm_scale, causal, block_q,
     # Residuals carry the *named* values: under jax.checkpoint the "dots"
     # policy (models/llama.py remat_policy_for) saves attn_out/attn_lse, so
     # the backward pass reads them instead of re-running the forward kernel
-    # (profiled at ~4% of step time as rematted_computation).
-    out = checkpoint_name(out, "attn_out")
+    # (profiled at ~4% of step time as rematted_computation). The named
+    # residual is the FLAT [B, S, H*D] view: saving the 4-D [B, S, H, 64]
+    # form would tile the 64-wide minor dim to 128 lanes — a 2x HBM pad on
+    # every saved attention output (PERF.md r4); the reshape back is free.
+    b, s, h, dd = out.shape
+    out_flat = checkpoint_name(out.reshape(b, s, h * dd), "attn_out")
+    out = out_flat.reshape(b, s, h, dd)
     lse = checkpoint_name(lse, "attn_lse")
-    return (out, lse), (q4, k4, v4, out, lse, qpos, kpos, rope)
+    return (out, lse), (q4, k4, v4, out_flat, lse, qpos, kpos, rope)
 
 
 def _flash_core_bwd(sm_scale, causal, block_q, block_k, interpret, res, cts):
-    q4, k4, v4, out, lse, qpos, kpos, rope = res
+    q4, k4, v4, out_flat, lse, qpos, kpos, rope = res
     do4, dlse = cts
+    out = out_flat.reshape(do4.shape)
     dq, dk, dv = _bwd(q4, k4, v4, out, lse, do4, dlse, qpos, kpos, rope,
                       sm_scale, causal, block_q, block_k, interpret)
     # rope tables get a zero cotangent (they are precomputed position

@@ -98,14 +98,16 @@ def route_topk(logits: jnp.ndarray, k: int,
                    aux, z_loss)
 
 
-def _swiglu_experts(slots: jnp.ndarray, w_gate, w_up, w_down) -> jnp.ndarray:
-    """Batched SwiGLU over expert slots: [E_local, C', H] with weight banks
-    [E_local, H, F] / [E_local, F, H]. bf16 MXU matmuls, fp32 accumulation
-    folded by XLA; mirrors the dense _mlp_block math."""
+def _swiglu_experts(slots: jnp.ndarray, w_gate, w_up, w_down,
+                    act=jax.nn.silu) -> jnp.ndarray:
+    """Batched gated MLP over expert slots: [E_local, C', H] with weight
+    banks [E_local, H, F] / [E_local, F, H]. bf16 MXU matmuls, fp32
+    accumulation folded by XLA; mirrors the dense _mlp_block math (`act`
+    is models.llama.mlp_act's choice — silu or gelu)."""
     dt = slots.dtype
     g = jnp.einsum("ech,ehf->ecf", slots, w_gate.astype(dt))
     u = jnp.einsum("ech,ehf->ecf", slots, w_up.astype(dt))
-    return jnp.einsum("ecf,efh->ech", jax.nn.silu(g) * u, w_down.astype(dt))
+    return jnp.einsum("ecf,efh->ech", act(g) * u, w_down.astype(dt))
 
 
 def moe_mlp(
@@ -118,6 +120,7 @@ def moe_mlp(
     num_experts: int,
     top_k: int,
     capacity_factor: float = 1.25,
+    act=jax.nn.silu,
     ep_axis: Optional[str] = None,
     router_aux_coef: float = 0.0,
     router_z_coef: float = 0.0,
@@ -172,7 +175,7 @@ def moe_mlp(
                              tiled=False)                         # [ep, El, cap, H]
         buf = jnp.moveaxis(buf, 0, 1).reshape(e_local, ep * cap, h)
 
-    out_slots = _swiglu_experts(buf, w_gate, w_up, w_down)
+    out_slots = _swiglu_experts(buf, w_gate, w_up, w_down, act=act)
 
     if ep_axis is not None and ep > 1:
         out_slots = out_slots.reshape(e_local, ep, cap, h)

@@ -86,10 +86,11 @@ def make_lr(t: TrainingConfig):
         boundaries=[t.lr_warmup_steps])
 
 
-# fp32-master bytes per streamed-update slice: big enough that the h2d/d2h
-# DMAs run near PCIe peak (measured ~5 GB/s aggregate at 64-128 MB on v5e),
-# small enough that double-buffered slices cost < 1 GB of HBM.
-_OFFLOAD_SLICE_BYTES = 128 * 2 ** 20
+# Minimum fp32-master bytes per streamed-update slice for axis-0 scanning
+# to beat a whole-leaf transfer: ~16 MB slices already run ~4 GB/s on v5e
+# (measured; the per-iteration latency floor dominates below that), and
+# tiny leaves (norms) go whole-leaf through the barrier chain instead.
+_OFFLOAD_MIN_SLICE_BYTES = 4 * 2 ** 20
 
 
 class OffloadAdamState(NamedTuple):
@@ -111,29 +112,58 @@ def _lr_at(t: TrainingConfig, count):
     return lr(count) if callable(lr) else jnp.asarray(lr, jnp.float32)
 
 
+def _global_sq_norm(grads, clip_specs):
+    """Global grad norm under shard_map: per-leaf local sum-of-squares,
+    psum'd over the mesh axes the leaf is SHARDED over (its PartitionSpec
+    axes — distinct shards sum to the global total; replicated leaves need
+    no collective and must not double-count). clip_specs None = local norm
+    (outside shard_map / single device)."""
+    total = jnp.zeros((), jnp.float32)
+    if clip_specs is None:
+        for g in jax.tree.leaves(grads):
+            total += jnp.sum(jnp.square(g.astype(jnp.float32)))
+        return jnp.sqrt(total)
+    from jax.sharding import PartitionSpec as P
+
+    g_leaves, treedef = jax.tree.flatten(grads)
+    s_leaves = jax.tree.leaves(clip_specs,
+                               is_leaf=lambda x: isinstance(x, P))
+    for g, spec in zip(g_leaves, s_leaves):
+        s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        axes = tuple(a for part in spec if part is not None
+                     for a in (part if isinstance(part, (tuple, list))
+                               else (part,)))
+        total += lax.psum(s, axes) if axes else s
+    return jnp.sqrt(total)
+
+
 def offload_adam_update(grads, state: OffloadAdamState, t: TrainingConfig,
-                        shardings, compute_dtype,
-                        memory_kind: str | None = "pinned_host",
-                        grad_scale=None):
-    """One AdamW step streamed through the device, leaf by leaf.
+                        compute_dtype, *, transfer: bool = True,
+                        clip_specs=None, grad_scale=None):
+    """One AdamW step streamed through the device, leaf by leaf — written
+    in PER-DEVICE terms so it runs INSIDE the train step's shard_map body:
+    every operand is this device's local shard, and host<->device movement
+    uses memory-space-only transfers (`jax.device_put(x, MemorySpace)`),
+    which carry no resharding semantics. Fusing the update into the grad
+    shard_map is load-bearing for memory: grads leaving a shard_map as
+    outputs cost a SECOND full fp32 tree (the while-loop grad carry cannot
+    alias a boundary output — measured 6-7 GB of waste at SmolLM-1.7B
+    scale, PERF.md r4).
 
-    grads: fp32 device pytree (already data-axis-averaged).
-    shardings: per-param-leaf NamedShardings (the params' PartitionSpecs —
-    a leaf's master and moments shard exactly like it; the host and device
-    memory-kind variants are derived here). memory_kind None (CPU tests)
-    runs the identical update without placement transfers. grad_scale (a
-    traced scalar, e.g. 1/token_count) is folded into the per-slice math so
-    the caller never materializes a divided copy of the grad tree — that
-    second 6.75 GB fp32 tree is what OOMed full-depth SmolLM-1.7B.
+    grads: fp32 local grad shards (data-axis-psum'd, NOT yet divided).
+    transfer False (CPU test meshes) runs the identical math without
+    placement transfers. clip_specs: the params' PartitionSpec tree, for
+    the cross-shard grad-norm psum (None = local norm). grad_scale (e.g.
+    1/token_count) is folded into the per-slice math so the caller never
+    materializes a divided copy of the grad tree.
 
-    Returns (new_params_compute_dtype_device, new_state). The math is
+    Returns (new_params_compute_dtype, new_state). The math is
     bit-identical to the on-device `scale_by_adam_low_moments` +
     `add_decayed_weights` + `scale_by_learning_rate` chain (and to
     optax.adamw for fp32 moments): offload changes WHERE state lives, not
-    what the update computes — that is the whole point of keeping an fp32
-    master. Each leaf's chain is h2d DMA -> fused elementwise -> d2h DMA;
-    XLA's latency-hiding scheduler overlaps the DMAs of different leaves
-    with each other and with neighboring compute."""
+    what the update computes."""
+    from jax._src.core import MemorySpace  # accepted by public device_put
+
     b1, b2, eps = t.adam_beta1, t.adam_beta2, t.adam_eps
     wd = t.weight_decay
     mdt = jnp.bfloat16 if t.adam_moments_dtype == "bfloat16" else jnp.float32
@@ -153,9 +183,14 @@ def offload_adam_update(grads, state: OffloadAdamState, t: TrainingConfig,
     scale = (jnp.asarray(1.0, jnp.float32) if grad_scale is None
              else jnp.asarray(grad_scale, jnp.float32))
     if t.grad_clip_norm > 0:
-        gn = optax.global_norm(grads) * scale
+        gn = _global_sq_norm(grads, clip_specs) * scale
         scale = scale * jnp.where(gn < t.grad_clip_norm, 1.0,
                                   t.grad_clip_norm / gn)
+
+    to_dev = (lambda x: jax.device_put(x, MemorySpace.Device)) if transfer \
+        else (lambda x: x)
+    to_host = (lambda x: jax.device_put(x, MemorySpace.Host)) if transfer \
+        else (lambda x: x)
 
     def math(p, m, n, g):
         g = g.astype(jnp.float32) * scale
@@ -170,108 +205,99 @@ def offload_adam_update(grads, state: OffloadAdamState, t: TrainingConfig,
         return (p2, m2.astype(mdt), n2.astype(mdt),
                 p2.astype(compute_dtype))
 
-    def leaf_whole(g, p_h, m_h, n_h, s, token):
-        dev = jax.sharding.NamedSharding(s.mesh, s.spec,
-                                         memory_kind="device")
-        host = jax.sharding.NamedSharding(s.mesh, s.spec,
-                                          memory_kind=memory_kind)
+    def leaf_whole(g, p_h, m_h, n_h, token):
         # Sequence this leaf's h2d DMAs after the previous leaf's update
         # compute: without the barrier XLA hoists every leaf's master +
         # moment transfers to the front of the update, and ~15 GB of fp32
         # state is live on device at once (measured: 17.6 GB peak, OOM).
         p_h, m_h, n_h, token = lax.optimization_barrier(
             (p_h, m_h, n_h, token))
-        p = jax.device_put(p_h, dev)
-        m = jax.device_put(m_h, dev).astype(jnp.float32)
-        n = jax.device_put(n_h, dev).astype(jnp.float32)
+        p = to_dev(p_h)
+        m = to_dev(m_h).astype(jnp.float32)
+        n = to_dev(n_h).astype(jnp.float32)
         p2, m2, n2 = math(p, m, n, g)
         token, p2 = lax.optimization_barrier((token, p2))
-        return (jax.device_put(p2, host),
-                jax.device_put(m2.astype(mdt), host),
-                jax.device_put(n2.astype(mdt), host),
+        return (to_host(p2),
+                to_host(m2.astype(mdt)),
+                to_host(n2.astype(mdt)),
                 p2.astype(compute_dtype)), token
 
-    def leaf_scanned(g, p_h, m_h, n_h, s, token, n_iters):
-        # Stream the leaf through the device in n_iters slices along axis 0:
-        # lax.scan's per-iteration dynamic-slice reads directly from the
-        # pinned-host buffer (one h2d DMA per slice) and the stacked outputs
+    def leaf_scanned(g, p_h, m_h, n_h, token):
+        # Stream the leaf through the device one axis-0 slice (= one layer
+        # of the local stacked-tree shard) at a time: lax.scan's
+        # per-iteration dynamic-slice reads directly from the pinned-host
+        # buffer (one h2d DMA per slice) and the stacked outputs
         # dynamic-update-slice back into a pinned-host result, so at most
-        # ~two ~128 MB slices of fp32 state are device-resident at any
-        # point. The reshape on the host operand is a bitcast (contiguous).
-        shape = p_h.shape
-        folded = (n_iters, shape[0] // n_iters) + shape[1:]
-        entries = tuple(s.spec) + (None,) * (len(shape) - len(s.spec))
-        slice_spec = jax.sharding.PartitionSpec(*entries)
-        dev = jax.sharding.NamedSharding(s.mesh, slice_spec,
-                                         memory_kind="device")
-        host = jax.sharding.NamedSharding(s.mesh, slice_spec,
-                                          memory_kind=memory_kind)
-
+        # ~two slices of fp32 state are device-resident at any point.
+        # Slicing MUST be the leaf's own leading axis: reshaping the host
+        # operand to fold layers into bigger chunks drops the async-DMA
+        # fast path (measured 4.8 -> 1.7 GB/s, PERF.md r4).
         def body(tok, xs):
             p_sl, m_sl, n_sl, g_sl = xs
-            # the token must DATA-DEPEND on each slice's work — a pass-
-            # through carry would be forwarded to the scan's init by the
-            # while-loop simplifier, severing the inter-leaf ordering chain
-            # (code review r4) and re-opening the transfer-hoisting OOM
-            # leaf_whole guards against
-            p_sl, tok = lax.optimization_barrier((p_sl, tok))
-            p = jax.device_put(p_sl, dev)
-            m = jax.device_put(m_sl, dev).astype(jnp.float32)
-            n = jax.device_put(n_sl, dev).astype(jnp.float32)
+            p = to_dev(p_sl)
+            m = to_dev(m_sl).astype(jnp.float32)
+            n = to_dev(n_sl).astype(jnp.float32)
             p2, m2, n2 = math(p, m, n, g_sl)
+            # the token must DATA-DEPEND on the slice work — a pass-through
+            # carry would be forwarded to the scan's init by the while-loop
+            # simplifier, severing the inter-leaf ordering chain that
+            # leaf_whole's barriers hang off (code review r4). Output-side
+            # only: an input-side barrier too was measured ~10% slower
+            # (it serializes the h2d against the previous iteration).
             tok, p2 = lax.optimization_barrier((tok, p2))
-            return tok, (jax.device_put(p2, host),
-                         jax.device_put(m2.astype(mdt), host),
-                         jax.device_put(n2.astype(mdt), host),
+            return tok, (to_host(p2),
+                         to_host(m2.astype(mdt)),
+                         to_host(n2.astype(mdt)),
                          p2.astype(compute_dtype))
 
-        token, (p2, m2, n2, pb) = lax.scan(
-            body, token,
-            (p_h.reshape(folded), m_h.reshape(folded), n_h.reshape(folded),
-             g.reshape(folded)))
-        return (p2.reshape(shape), m2.reshape(shape), n2.reshape(shape),
-                pb.reshape(shape)), token
+        token, out = lax.scan(body, token, (p_h, m_h, n_h, g))
+        return out, token
 
-    def n_scan_iters(p_h, s) -> int:
-        """Slices to stream a leaf in (1 = whole-leaf). Only leaves whose
-        axis 0 is effectively unsharded stream sliced — slicing a genuinely
-        sharded axis under GSPMD would insert gathers. (A dim "sharded"
-        over size-1 mesh axes is unsharded.)"""
+    def scannable(p_h) -> bool:
+        """Stream sliced along axis 0 (one slice per stacked layer of the
+        LOCAL shard — inside shard_map the leading axis is always safe to
+        slice)? Short enough to be a layer stack rather than a
+        vocab/feature dim, big enough per slice for the DMA to run near
+        peak."""
         shape = p_h.shape
-        if len(shape) < 2 or shape[0] <= 1:
-            return 1
-        entries = tuple(s.spec) + (None,) * (len(shape) - len(s.spec))
-        e0 = entries[0]
-        if e0 is not None:
-            axes = e0 if isinstance(e0, (tuple, list)) else (e0,)
-            size = 1
-            for a in axes:
-                size *= s.mesh.shape[a]
-            if size > 1:
-                return 1
-        want = max(1, round(p_h.nbytes / _OFFLOAD_SLICE_BYTES))
-        n = min(want, shape[0])
-        while shape[0] % n:
-            n -= 1
-        return n
+        if len(shape) < 2 or not 2 <= shape[0] <= 1024:
+            return False
+        return p_h.nbytes // shape[0] >= _OFFLOAD_MIN_SLICE_BYTES
 
-    token = jnp.zeros((), jnp.float32)
+    # One ordering token PER VMA CLASS (the set of mesh axes a leaf varies
+    # over inside shard_map): the optimization_barrier chain joins the
+    # varying-axes type of everything it groups, so a single token would
+    # leak e.g. the embedding's {tp} onto the replicated norms' outputs and
+    # fail the out_specs vma check. Leaves of the same class (in practice:
+    # all the big tp-sharded matrices) still chain — which is where the
+    # DMA-hoisting memory bound matters; the off-class leaves are the KB-
+    # sized norms. Outside shard_map every vma is empty and this is one
+    # global token, exactly the old behavior.
+    tokens: dict = {}
+
+    def token_for(leaf):
+        key = frozenset(getattr(jax.typeof(leaf), "vma", frozenset()))
+        if key not in tokens:
+            tok = jnp.zeros((), jnp.float32)
+            if key:
+                tok = lax.pvary(tok, tuple(sorted(key)))
+            tokens[key] = tok
+        return key, tokens[key]
+
     g_leaves, treedef = jax.tree.flatten(grads)
     p_leaves = treedef.flatten_up_to(state.master)
     m_leaves = treedef.flatten_up_to(state.mu)
     n_leaves = treedef.flatten_up_to(state.nu)
-    s_leaves = treedef.flatten_up_to(shardings)
     out = []
-    for g, p_h, m_h, n_h, s in zip(g_leaves, p_leaves, m_leaves, n_leaves,
-                                   s_leaves):
-        if memory_kind is None:
+    for g, p_h, m_h, n_h in zip(g_leaves, p_leaves, m_leaves, n_leaves):
+        if not transfer:
             out.append(leaf_plain(g, p_h, m_h, n_h))
             continue
-        n_iters = n_scan_iters(p_h, s)
-        if n_iters == 1:
-            o, token = leaf_whole(g, p_h, m_h, n_h, s, token)
+        key, token = token_for(p_h)
+        if scannable(p_h):
+            o, tokens[key] = leaf_scanned(g, p_h, m_h, n_h, token)
         else:
-            o, token = leaf_scanned(g, p_h, m_h, n_h, s, token, n_iters)
+            o, tokens[key] = leaf_whole(g, p_h, m_h, n_h, token)
         out.append(o)
     pick = lambda i: jax.tree.unflatten(  # noqa: E731
         treedef, [o[i] for o in out])

@@ -10,9 +10,15 @@ DataParallelBucket -> train_step dispatch, ref: train.py:174-231):
   (ref: data_parallel.py:83, bucket.py:25-31). XLA's all-reduce combiner
   plays the role of the 25MB bucket manager, and its latency-hiding
   scheduler overlaps the reduction with remaining backward compute.
-- the optimizer update runs *outside* shard_map in plain GSPMD land, so
-  optax transforms (incl. global-norm clipping) see global arrays and
-  gradient-norm reductions span all shards automatically.
+- the standard (on-device) optimizer update runs *outside* shard_map in
+  plain GSPMD land, so optax transforms (incl. global-norm clipping) see
+  global arrays and gradient-norm reductions span all shards
+  automatically. Under `optimizer_offload` the update instead runs
+  INSIDE the same shard_map body as the gradients (grads crossing the
+  boundary as outputs cost a second full fp32 grad tree — PERF.md r4);
+  there the hand-rolled streamed AdamW (optimizer.offload_adam_update)
+  reproduces the optax math per shard, with an explicit per-leaf psum
+  over each param's sharded axes for the global grad norm.
 - one uniform code path for every (dp, pp, cp, tp) size — collectives over
   size-1 axes compile away, so there are no `if tp > 1` forks in the traced
   program (the reference dispatches between four wrapper stacks).
@@ -353,17 +359,36 @@ def make_train_step(cfg: Config, menv: MeshEnv):
     if cfg.training.optimizer_offload:
         from picotron_tpu.models.llama import compute_dtype
 
-        shardings = param_shardings(cfg, mesh)
         cdt = compute_dtype(cfg.model)
-        kind = offload_memory_kind(mesh)
+        transfer = offload_memory_kind(mesh) is not None
+
+        # The update runs INSIDE the shard_map body, fused with the grad
+        # computation: grads crossing the shard_map boundary as outputs
+        # cost a SECOND full fp32 grad tree (the grad-accumulation while
+        # carry cannot alias a boundary output — measured 6-7 GB of pure
+        # waste at SmolLM-1.7B scale). Inside, every leaf is this device's
+        # local shard and the host<->device moves are memory-space-only
+        # transfers, so the same body is correct on any mesh (each process
+        # streams exactly its own host-resident state shards).
+        def _device_step(params, batch, opt_state):
+            grads, loss, extras = _device_grads(params, batch, cfg)
+            grad_scale = extras.pop("_grad_scale")
+            new_params, new_opt = offload_adam_update(
+                grads, opt_state, cfg.training, cdt, transfer=transfer,
+                clip_specs=pspecs, grad_scale=grad_scale)
+            return new_params, new_opt, loss, extras
+
+        opt_specs = OffloadAdamState(count=P(), master=pspecs, mu=pspecs,
+                                     nu=pspecs)
+        fused = jax.shard_map(
+            _device_step, mesh=mesh,
+            in_specs=(pspecs, (bspec, bspec), opt_specs),
+            out_specs=(pspecs, opt_specs, P(), P()))
 
         @partial(jax.jit, donate_argnums=(0,))
         def step(state: TrainState, batch):
-            grads, loss, extras = grad_fn(state.params, batch)
-            grad_scale = extras.pop("_grad_scale")
-            new_params, new_opt = offload_adam_update(
-                grads, state.opt_state, cfg.training, shardings, cdt,
-                memory_kind=kind, grad_scale=grad_scale)
+            new_params, new_opt, loss, extras = fused(
+                state.params, batch, state.opt_state)
             metrics = {"loss": loss, **extras}
             return TrainState(new_params, new_opt, state.step + 1), metrics
 

@@ -443,3 +443,55 @@ def test_rope_scaled_model_trains_and_decodes():
     got = teacher_forced_cache_logits(p, cfg_m, toks)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_gelu_hidden_act_changes_mlp_and_matches_reference():
+    """hidden_act='gelu' (GeGLU, the Gemma-style gated MLP): the gate
+    branch must use tanh-approx gelu instead of silu, in the dense MLP,
+    the MoE expert bank, and by construction the decode path (all three
+    route through models.llama.mlp_act)."""
+    from picotron_tpu.config import resolve_preset
+    from picotron_tpu.models.llama import forward, init_params, mlp_act
+
+    base = dict(resolve_preset("debug-tiny"), dtype="float32")
+    cfg_s = ModelConfig(**base)
+    cfg_g = ModelConfig(**{**base, "hidden_act": "gelu"})
+    params = init_params(cfg_s, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 16), 0, 256)
+
+    out_s = forward(params, ids, cfg_s)
+    out_g = forward(params, ids, cfg_g)
+    assert not np.allclose(np.asarray(out_s), np.asarray(out_g))
+
+    # "gelu" is the EXACT erf GELU (transformers' ACT2FN "gelu");
+    # "gelu_tanh" is the tanh approximation (gelu_pytorch_tanh/gelu_new)
+    x = jnp.linspace(-3, 3, 64)
+    np.testing.assert_allclose(
+        np.asarray(mlp_act(cfg_g)(x)),
+        np.asarray(jax.nn.gelu(x, approximate=False)), rtol=1e-7)
+    cfg_gt = ModelConfig(**{**base, "hidden_act": "gelu_tanh"})
+    np.testing.assert_allclose(
+        np.asarray(mlp_act(cfg_gt)(x)),
+        np.asarray(jax.nn.gelu(x, approximate=True)), rtol=1e-7)
+    from picotron_tpu.config import model_config_from_hf_json
+    hf_base = {"vocab_size": 8, "hidden_size": 8, "intermediate_size": 8,
+               "num_hidden_layers": 1, "num_attention_heads": 2}
+    assert model_config_from_hf_json(
+        {**hf_base, "hidden_act": "gelu"})["hidden_act"] == "gelu"
+    assert model_config_from_hf_json(
+        {**hf_base, "hidden_act": "gelu_pytorch_tanh"})["hidden_act"] \
+        == "gelu_tanh"
+
+    with pytest.raises(ValueError, match="hidden_act"):
+        ModelConfig(**{**base, "hidden_act": "relu"}).validate()
+
+    # MoE bank honors it too
+    from picotron_tpu.ops.moe import _swiglu_experts
+    slots = jax.random.normal(jax.random.key(2), (2, 8, 16))
+    wg = jax.random.normal(jax.random.key(3), (2, 16, 32)) * 0.1
+    wu = jax.random.normal(jax.random.key(4), (2, 16, 32)) * 0.1
+    wd = jax.random.normal(jax.random.key(5), (2, 32, 16)) * 0.1
+    o_s = _swiglu_experts(slots, wg, wu, wd)
+    o_g = _swiglu_experts(slots, wg, wu, wd,
+                          act=mlp_act(cfg_g))
+    assert not np.allclose(np.asarray(o_s), np.asarray(o_g))

@@ -136,7 +136,6 @@ def test_update_math_matches_optax_chain():
         master=params,
         mu=jax.tree.map(lambda p: jnp.zeros_like(p, jnp.bfloat16), params),
         nu=jax.tree.map(lambda p: jnp.zeros_like(p, jnp.bfloat16), params))
-    shardings = jax.tree.map(lambda _: None, params)
 
     p_ref = params
     for i in range(3):
@@ -146,7 +145,7 @@ def test_update_math_matches_optax_chain():
         updates, opt_state = opt.update(grads, opt_state, p_ref)
         p_ref = optax.apply_updates(p_ref, updates)
         compute, off_state = offload_adam_update(
-            grads, off_state, t, shardings, jnp.bfloat16, memory_kind=None)
+            grads, off_state, t, jnp.bfloat16, transfer=False)
     for r, o in zip(jax.tree.leaves(p_ref),
                     jax.tree.leaves(off_state.master)):
         np.testing.assert_allclose(np.asarray(r), np.asarray(o),
@@ -169,13 +168,11 @@ def test_grad_scale_folds_into_update():
         return OffloadAdamState(count=jnp.zeros((), jnp.int32),
                                 master=params, mu=zeros, nu=zeros)
 
-    shardings = {"w": None}
     grads = {"w": jnp.ones((3, 4)) * 8.0}
-    _, s1 = offload_adam_update(grads, fresh(), t, shardings, jnp.bfloat16,
-                                memory_kind=None, grad_scale=0.25)
+    _, s1 = offload_adam_update(grads, fresh(), t, jnp.bfloat16,
+                                transfer=False, grad_scale=0.25)
     _, s2 = offload_adam_update(jax.tree.map(lambda g: g * 0.25, grads),
-                                fresh(), t, shardings, jnp.bfloat16,
-                                memory_kind=None)
+                                fresh(), t, jnp.bfloat16, transfer=False)
     np.testing.assert_allclose(np.asarray(s1.master["w"]),
                                np.asarray(s2.master["w"]), rtol=1e-6)
 
@@ -186,27 +183,24 @@ def test_streamed_update_structure(monkeypatch):
     compile and produce the same numbers as the plain path)."""
     import picotron_tpu.optimizer as opt_mod
 
-    # force scanning: every leaf > 1 KB streams in axis-0 slices
-    monkeypatch.setattr(opt_mod, "_OFFLOAD_SLICE_BYTES", 1024)
+    # force scanning: every leaf with > 4-byte axis-0 slices streams
+    monkeypatch.setattr(opt_mod, "_OFFLOAD_MIN_SLICE_BYTES", 4)
     t = TrainingConfig(learning_rate=1e-2, adam_moments_dtype="bfloat16")
-    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("x",))
-    sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
     params = {"big": jnp.arange(24 * 64, dtype=jnp.float32).reshape(24, 64)
               / 512, "small": jnp.ones((4,))}
     zeros_b = jax.tree.map(lambda p: jnp.zeros_like(p, jnp.bfloat16), params)
     state = OffloadAdamState(count=jnp.zeros((), jnp.int32), master=params,
                              mu=zeros_b, nu=zeros_b)
     grads = jax.tree.map(jnp.ones_like, params)
-    shardings = jax.tree.map(lambda _: sh, params)
 
     @jax.jit
     def run(grads, state):
-        return offload_adam_update(grads, state, t, shardings, jnp.bfloat16,
-                                   memory_kind="device")
+        return offload_adam_update(grads, state, t, jnp.bfloat16,
+                                   transfer=True)
 
     compute, new_state = run(grads, state)
-    _, plain = offload_adam_update(grads, state, t, shardings, jnp.bfloat16,
-                                   memory_kind=None)
+    _, plain = offload_adam_update(grads, state, t, jnp.bfloat16,
+                                   transfer=False)
     for a, b in zip(jax.tree.leaves(new_state.master),
                     jax.tree.leaves(plain.master)):
         # atol: XLA fuses sqrt/div differently inside the scan body than in

@@ -73,11 +73,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--eval-steps", type=int, default=8)
     p.add_argument("--eval-split", default=None)
     p.add_argument("--no-remat", action="store_true")
+    p.add_argument("--remat-policy", default="dots",
+                   choices=["full", "dots", "dots_attn", "dots_lean", "dots_norms",
+                            "dots_offload"])
     p.add_argument("--adam-moments-dtype", default="float32",
                    choices=["float32", "bfloat16"],
                    help="bf16 halves optimizer-state memory (update math "
                         "stays fp32) — usually required to fit >1B models "
                         "per 16G chip; check with tools/memcheck.py")
+    p.add_argument("--optimizer-offload", action="store_true",
+                   help="fp32 master + Adam moments in pinned HOST memory "
+                        "(the full-depth-on-one-chip lever; pair with "
+                        "--grad-acc >= 16 to amortize the PCIe round "
+                        "trip; requires bf16 model dtype)")
     # dataset
     p.add_argument("--dataset", default="synthetic")
     p.add_argument("--subset", default=None)
@@ -148,7 +156,9 @@ def create_single_config(args) -> str:
             "eval_frequency": args.eval_frequency,
             "eval_steps": args.eval_steps,
             "adam_moments_dtype": args.adam_moments_dtype,
+            "optimizer_offload": args.optimizer_offload,
             "remat": not args.no_remat,
+            "remat_policy": args.remat_policy,
         },
         "dataset": {
             "name": args.dataset, "subset_name": args.subset,

@@ -172,6 +172,7 @@ def watch_queue(exp_dir: str, job_ids: dict[str, str], interval: float = 30.0,
     job has left the queue."""
     watched = dict(job_ids)  # name -> slurm job id
     polls = 0
+    consecutive_failures = 0
     while watched and (max_polls is None or polls < max_polls):
         out = subprocess.run(
             ["squeue", "--noheader", "--format=%i %T",
@@ -180,10 +181,21 @@ def watch_queue(exp_dir: str, job_ids: dict[str, str], interval: float = 30.0,
         if out.returncode != 0:
             # transient slurmctld hiccup: an empty answer here must NOT be
             # read as "every job left the queue" (that would mark pending
-            # jobs fail); skip the poll and retry
+            # jobs fail); skip the poll and retry — but a PERSISTENT
+            # failure (e.g. "Invalid job id": the jobs completed and
+            # slurmctld purged them past MinJobAge) must not loop forever:
+            # give up after a few polls and leave status.txt to the
+            # scripts' own epilogues (code review r4)
+            consecutive_failures += 1
+            if consecutive_failures >= 5:
+                print(f"  watch: squeue failing persistently "
+                      f"({out.stderr.strip()[:120]}); stopping the watcher "
+                      f"for {sorted(watched)}")
+                return
             polls += 1
             time.sleep(interval)
             continue
+        consecutive_failures = 0
         states = {}
         for line in out.stdout.splitlines():
             parts = line.split()
