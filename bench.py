@@ -35,6 +35,10 @@ SWEEP = [
     ("SmolLM-1.7B", 8, 4096, 2, {}),
     ("SmolLM-1.7B", 4, 16384, 1, {}),     # long-context: blocked-KV flash
     ("SmolLM-1.7B", 8, 2048, 5, {}),      # depth-reduced peak-MFU config
+    # FULL depth at seq 4096 — long context + optimizer offload compose
+    # (row-group update streaming keeps the embedding/lm_head transients
+    # off the peak; PERF.md r4)
+    ("SmolLM-1.7B", None, 4096, 1, OFFLOAD_24L),
     # headline: the FULL 24-layer model on one chip — fp32 master + Adam
     # moments live in pinned host memory (optimizer_offload), grad-acc 64
     # amortizes the PCIe round trip (mbs 2 x 64 x 2048 = 262k tokens/step

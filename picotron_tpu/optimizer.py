@@ -91,6 +91,10 @@ def make_lr(t: TrainingConfig):
 # (measured; the per-iteration latency floor dominates below that), and
 # tiny leaves (norms) go whole-leaf through the barrier chain instead.
 _OFFLOAD_MIN_SLICE_BYTES = 4 * 2 ** 20
+# Target fp32-master bytes per ROW GROUP when streaming big-axis-0 leaves
+# (embedding/lm_head): ~32 MB groups measured 4.0 GB/s via
+# dynamic_slice_in_dim on the pinned-host buffer.
+_OFFLOAD_ROW_GROUP_BYTES = 32 * 2 ** 20
 
 
 class OffloadAdamState(NamedTuple):
@@ -253,6 +257,61 @@ def offload_adam_update(grads, state: OffloadAdamState, t: TrainingConfig,
         token, out = lax.scan(body, token, (p_h, m_h, n_h, g))
         return out, token
 
+    def leaf_scanned_rows(g, p_h, m_h, n_h, token, group):
+        # Row-group streaming for leaves whose axis 0 is a big vocab/
+        # feature dim (embedding, lm_head): explicit dynamic_slice_in_dim
+        # with a computed offset keeps the async host-DMA fast path
+        # (measured 4.0 GB/s — a host RESHAPE to fold rows would drop it
+        # to 1.7) while capping the device-resident transient at one
+        # ~32 MB group instead of the whole 400 MB leaf chain.
+        n = p_h.shape[0] // group
+
+        def body(tok, i):
+            def sl(x):
+                return lax.dynamic_slice_in_dim(x, i * group, group, 0)
+
+            p = to_dev(sl(p_h))
+            m = to_dev(sl(m_h)).astype(jnp.float32)
+            nn = to_dev(sl(n_h)).astype(jnp.float32)
+            p2, m2, n2 = math(p, m, nn, sl(g))
+            tok, p2 = lax.optimization_barrier((tok, p2))
+            return tok, (to_host(p2),
+                         to_host(m2.astype(mdt)),
+                         to_host(n2.astype(mdt)),
+                         p2.astype(compute_dtype))
+
+        token, ys = lax.scan(body, token, jnp.arange(n))
+        shape = p_h.shape
+        out = tuple(y.reshape(shape) for y in ys)
+        return out, token
+
+    def row_group(p_h) -> int:
+        """Group size for leaf_scanned_rows (0 = not applicable): a
+        divisor of axis 0 whose group stays near _OFFLOAD_ROW_GROUP_BYTES.
+        Searches below the target first, then up to 4x above it, so vocab
+        sizes without a divisor right at the target still stream (e.g.
+        49152/151936/128256 all do). A genuinely prime-ish axis 0 (GPT-2's
+        50257) has no usable divisor and falls back to the whole-leaf
+        path — acceptable: its transient is one leaf, and scan slices
+        must be uniform."""
+        shape = p_h.shape
+        if len(shape) < 2 or shape[0] <= 1024:
+            return 0
+        row_bytes = p_h.nbytes // shape[0]
+        target = max(1, _OFFLOAD_ROW_GROUP_BYTES // max(row_bytes, 1))
+        gsz = min(target, shape[0])
+        while gsz > 1 and shape[0] % gsz:
+            gsz -= 1
+        if gsz > 1 and gsz * row_bytes >= _OFFLOAD_MIN_SLICE_BYTES \
+                and gsz < shape[0]:
+            return gsz
+        # nothing usable at-or-below the target: take the smallest divisor
+        # above it (bounded, so the transient stays within ~4x the target)
+        for cand in range(target + 1, min(4 * target, shape[0] - 1) + 1):
+            if shape[0] % cand == 0:
+                return cand
+        return 0
+
     def scannable(p_h) -> bool:
         """Stream sliced along axis 0 (one slice per stacked layer of the
         LOCAL shard — inside shard_map the leading axis is always safe to
@@ -296,6 +355,8 @@ def offload_adam_update(grads, state: OffloadAdamState, t: TrainingConfig,
         key, token = token_for(p_h)
         if scannable(p_h):
             o, tokens[key] = leaf_scanned(g, p_h, m_h, n_h, token)
+        elif (grp := row_group(p_h)):
+            o, tokens[key] = leaf_scanned_rows(g, p_h, m_h, n_h, token, grp)
         else:
             o, tokens[key] = leaf_whole(g, p_h, m_h, n_h, token)
         out.append(o)

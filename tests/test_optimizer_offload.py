@@ -183,11 +183,20 @@ def test_streamed_update_structure(monkeypatch):
     compile and produce the same numbers as the plain path)."""
     import picotron_tpu.optimizer as opt_mod
 
-    # force scanning: every leaf with > 4-byte axis-0 slices streams
+    # force streaming: tiny slice floor + tiny row-group target so "big"
+    # takes leaf_scanned and "wide" takes leaf_scanned_rows (groups of
+    # 64/8-byte-rows = 8 rows -> 256 scan iterations + reshape reassembly)
     monkeypatch.setattr(opt_mod, "_OFFLOAD_MIN_SLICE_BYTES", 4)
+    monkeypatch.setattr(opt_mod, "_OFFLOAD_ROW_GROUP_BYTES", 64)
     t = TrainingConfig(learning_rate=1e-2, adam_moments_dtype="bfloat16")
+    # three leaves, one per streaming path: "big" -> leaf_scanned (axis-0
+    # layer slices), "wide" -> leaf_scanned_rows (axis 0 > 1024, row
+    # groups + reshape reassembly), "small" -> leaf_whole
     params = {"big": jnp.arange(24 * 64, dtype=jnp.float32).reshape(24, 64)
-              / 512, "small": jnp.ones((4,))}
+              / 512,
+              "wide": jnp.arange(2048 * 2, dtype=jnp.float32).reshape(
+                  2048, 2) / 4096,
+              "small": jnp.ones((4,))}
     zeros_b = jax.tree.map(lambda p: jnp.zeros_like(p, jnp.bfloat16), params)
     state = OffloadAdamState(count=jnp.zeros((), jnp.int32), master=params,
                              mu=zeros_b, nu=zeros_b)
