@@ -124,21 +124,33 @@ class CheckpointManager:
             return False
         try:
             return bool(self._ocp.utils.is_checkpoint_finalized(state_dir))
-        except ValueError:
-            # "not an Orbax-managed checkpoint path": on posix the atomic
-            # rename into place IS the commit, so an existing dir without
-            # Orbax finalization metadata is durable.
+        except ValueError as e:
+            # "not an Orbax-managed checkpoint path" (older Orbax APIs).
+            # json.JSONDecodeError subclasses ValueError, so a torn
+            # finalization-metadata file must NOT ride this branch to
+            # "durable" (ADVICE r4) — it falls through to the not-durable
+            # handler. For a genuine not-an-orbax-path error the isdir
+            # probe above already established a local-filesystem path,
+            # where Orbax commits by atomic rename — the final `state`
+            # dir existing at all means the rename happened, so absent
+            # Orbax metadata the checkpoint is durable.
+            if isinstance(e, json.JSONDecodeError):
+                return self._probe_failed(state_dir, e)
             return True
         except Exception as e:  # noqa: BLE001
             # Transient metadata read errors (GCS-style stores — exactly
             # the case the finalization check exists for) must NOT classify
             # an in-flight/torn checkpoint as durable (ADVICE r3). Skip it;
             # a genuinely durable step is re-discovered on the next probe.
-            import warnings
+            return self._probe_failed(state_dir, e)
 
-            warnings.warn(f"checkpoint durability probe failed for "
-                          f"{state_dir}: {e!r}; treating as not durable")
-            return False
+    @staticmethod
+    def _probe_failed(state_dir: str, e: Exception) -> bool:
+        import warnings
+
+        warnings.warn(f"checkpoint durability probe failed for "
+                      f"{state_dir}: {e!r}; treating as not durable")
+        return False
 
     def latest_step(self) -> Optional[int]:
         """Newest *durable* checkpoint step. An async save that has not

@@ -682,6 +682,19 @@ class Config:
                     "(the device-resident compute copy is the model's "
                     "compute dtype; an fp32 compute copy would duplicate "
                     "the master and save nothing)")
+            if d.pp_size > 1 and d.pp_engine == "afab":
+                # afab differentiates through the pipeline scan, so param
+                # cotangents accumulate in the param dtype — bf16 under
+                # offload, losing exactly the low bits the fp32 master
+                # keeps. The 1f1b manual-VJP path accumulates its grads in
+                # fp32 explicitly (pp.py g_zero) and is the supported
+                # offload x pp combination (ADVICE r4).
+                raise ValueError(
+                    "optimizer_offload with pp_engine='afab' would "
+                    "accumulate microbatch gradients in bf16 (the AD "
+                    "path's cotangent dtype is the bf16 param dtype); "
+                    "use pp_engine='1f1b' (the default), whose manual "
+                    "VJP accumulates gradients in fp32")
         lg = self.logging
         if lg.profile_dir is not None:
             if lg.profile_start_step < 1:

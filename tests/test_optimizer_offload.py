@@ -303,3 +303,10 @@ def test_offload_rejects_zero1_and_fp32_compute():
             model=ModelConfig(dtype="float32"),
             training=TrainingConfig(optimizer_offload=True),
         ).validate()
+    # afab accumulates param cotangents in the bf16 param dtype (ADVICE r4)
+    with pytest.raises(ValueError, match="afab"):
+        Config(
+            distributed=DistributedConfig(pp_size=2, pp_engine="afab"),
+            model=ModelConfig(),
+            training=TrainingConfig(optimizer_offload=True),
+        ).validate()

@@ -101,7 +101,13 @@ def main() -> None:
             *path, key = dotted.split(".")
             for p in path:
                 node = node.setdefault(p, {})
-            node[key] = json.loads(val)  # true/false/numbers/strings-quoted
+            try:
+                node[key] = json.loads(val)  # true/false/numbers/lists
+            except ValueError:
+                # bare strings stay strings: `--override
+                # training.remat_policy=dots_attn` must not demand shell-
+                # quoted embedded JSON quotes (ADVICE r4)
+                node[key] = val
         tmp = tempfile.NamedTemporaryFile(
             "w", suffix=".json", delete=False)
         json.dump(raw, tmp)
