@@ -120,8 +120,19 @@ class CheckpointManager:
         the write is still in flight, so a bare isdir test would hand
         restore a torn checkpoint; code review r3)."""
         state_dir = os.path.join(self.directory, step_dirname, "state")
-        if not os.path.isdir(state_dir):
-            return False
+        try:
+            from etils import epath
+
+            # epath (Orbax's own path layer) so gs://-style directories
+            # probe correctly — os.path.isdir is always False on URL
+            # paths, which would classify every remote checkpoint as
+            # not-durable and silently disable auto-resume (code review
+            # r5)
+            if not epath.Path(state_dir).is_dir():
+                return False
+        except ImportError:
+            if not os.path.isdir(state_dir):
+                return False
         try:
             return bool(self._ocp.utils.is_checkpoint_finalized(state_dir))
         except ValueError as e:
@@ -129,12 +140,12 @@ class CheckpointManager:
             # json.JSONDecodeError subclasses ValueError, so a torn
             # finalization-metadata file must NOT ride this branch to
             # "durable" (ADVICE r4) — it falls through to the not-durable
-            # handler. For a genuine not-an-orbax-path error the isdir
-            # probe above already established a local-filesystem path,
-            # where Orbax commits by atomic rename — the final `state`
-            # dir existing at all means the rename happened, so absent
-            # Orbax metadata the checkpoint is durable.
-            if isinstance(e, json.JSONDecodeError):
+            # handler. The durable=True conclusion holds only for LOCAL
+            # paths, where Orbax commits by atomic rename (the final
+            # `state` dir existing at all means the rename happened);
+            # URL-style stores commit via marker files, so absent metadata
+            # there means possibly-torn, not durable.
+            if isinstance(e, json.JSONDecodeError) or "://" in state_dir:
                 return self._probe_failed(state_dir, e)
             return True
         except Exception as e:  # noqa: BLE001

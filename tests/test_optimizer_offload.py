@@ -192,8 +192,15 @@ def test_streamed_update_structure(monkeypatch):
     # three leaves, one per streaming path: "big" -> leaf_scanned (axis-0
     # layer slices), "wide" -> leaf_scanned_rows (axis 0 > 1024, row
     # groups + reshape reassembly), "small" -> leaf_whole
+    # big/big2 share a depth (one fused group of TWO members — their
+    # distinct values catch a member-order swap in the group scatter),
+    # big3 has its own depth (a second, single-member group)
     params = {"big": jnp.arange(24 * 64, dtype=jnp.float32).reshape(24, 64)
               / 512,
+              "big2": -jnp.arange(24 * 64, dtype=jnp.float32).reshape(24, 64)
+              / 1024,
+              "big3": jnp.arange(12 * 64, dtype=jnp.float32).reshape(12, 64)
+              / 256,
               "wide": jnp.arange(2048 * 2, dtype=jnp.float32).reshape(
                   2048, 2) / 4096,
               "small": jnp.ones((4,))}

@@ -27,6 +27,7 @@ import argparse
 import dataclasses
 import json
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -104,9 +105,23 @@ def main() -> None:
             try:
                 node[key] = json.loads(val)  # true/false/numbers/lists
             except ValueError:
-                # bare strings stay strings: `--override
+                # identifier-like bare strings stay strings: `--override
                 # training.remat_policy=dots_attn` must not demand shell-
-                # quoted embedded JSON quotes (ADVICE r4)
+                # quoted embedded JSON quotes (ADVICE r4). Anything else
+                # (a typo'd literal like `flase`, broken JSON) stays a
+                # loud error — a truthy string silently flipping a bool
+                # knob ON would measure the wrong config (code review r5).
+                prev = node.get(key)
+                if isinstance(prev, (bool, int, float)) \
+                        or not re.fullmatch(r"[A-Za-z_][A-Za-z0-9_./-]*",
+                                            val) \
+                        or val in ("True", "False", "None"):
+                    # a typo'd literal (`zero1=flase`) must not become a
+                    # truthy string that silently flips a non-string knob
+                    raise SystemExit(
+                        f"--override {dotted}={val!r}: not valid JSON, "
+                        f"and the existing value "
+                        f"({prev!r}) is not a string")
                 node[key] = val
         tmp = tempfile.NamedTemporaryFile(
             "w", suffix=".json", delete=False)
