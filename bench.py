@@ -40,12 +40,14 @@ SWEEP = [
     # off the peak; PERF.md r4)
     ("SmolLM-1.7B", None, 4096, 1, OFFLOAD_24L),
     # headline: the FULL 24-layer model on one chip — fp32 master + Adam
-    # moments live in pinned host memory (optimizer_offload), grad-acc 64
-    # amortizes the PCIe round trip (mbs 2 x 64 x 2048 = 262k tokens/step
-    # = SmolLM's real ~2M-token global batch at the reference's 8-GPU
-    # scale). Matches the reference's full-depth ~50% bar honestly
-    # (ref: README.md:7).
-    ("SmolLM-1.7B", None, 2048, 2, OFFLOAD_24L),
+    # moments live in pinned host memory (optimizer_offload), the fused
+    # grad engine accumulates dW in-scan (PERF.md r5), and grad-acc 43
+    # amortizes the PCIe round trip (mbs 3 x 43 x 2048 = 264k tokens/step
+    # ~= SmolLM's real ~2M-token global batch at the reference's 8-GPU
+    # scale; mbs 3 fits because the fused engine never materializes the
+    # per-microbatch grad tree). Beats the reference's full-depth ~50%
+    # bar (ref: README.md:7).
+    ("SmolLM-1.7B", None, 2048, 3, dict(OFFLOAD_24L, grad_acc=43)),
 ]
 
 
@@ -297,8 +299,8 @@ def main() -> None:
         args.optimizer_offload = True
     if args.optimizer_offload:
         args.layers = args.layers or 0
-        args.mbs = args.mbs or 2
-        args.grad_acc = args.grad_acc or 64
+        args.mbs = args.mbs or 3
+        args.grad_acc = args.grad_acc or 43
         args.remat_policy = args.remat_policy or "dots_attn"
     else:
         if args.layers is None and args.model == "SmolLM-1.7B":

@@ -167,11 +167,21 @@ class CheckpointManager:
         """Newest *durable* checkpoint step. An async save that has not
         committed yet (or a crashed one) is skipped rather than handed to
         restore (see _is_durable)."""
-        if not os.path.isdir(self.directory):
-            return None
+        try:
+            from etils import epath
+
+            # epath so URL-style stores (gs://) enumerate too —
+            # os.listdir would silently find nothing there and disable
+            # auto-resume (code review r5)
+            root = epath.Path(self.directory)
+            names = ([p.name for p in root.iterdir()]
+                     if root.is_dir() else [])
+        except ImportError:
+            names = (os.listdir(self.directory)
+                     if os.path.isdir(self.directory) else [])
         steps = [
             int(m.group(1))
-            for d in os.listdir(self.directory)
+            for d in names
             if (m := re.fullmatch(r"step_(\d+)", d)) and self._is_durable(d)
         ]
         return max(steps) if steps else None

@@ -503,6 +503,17 @@ class TrainingConfig:
     # "dots_norms" additionally saves RMSNorm outputs (~2 activations/layer
     # more HBM, less backward recompute).
     remat_policy: str = "dots"
+    # Gradient engine for the non-pipeline microbatch loop: "ad"
+    # differentiates each microbatch and tree-adds into the fp32
+    # accumulator (one whole-tree temp write + one whole-tree add per
+    # microbatch — measured 26 ms of serialized roofline HBM traffic per
+    # microbatch at SmolLM-1.7B, PERF.md r5); "fused" runs the manual
+    # backward layer scan (parallel/fused_bwd.py) that accumulates each
+    # layer's dW in-scan, eliminating both passes. "auto" picks "fused"
+    # whenever it is supported (dense, pp=cp=1, no SP, remat dots_attn)
+    # and gradient accumulation is in play. Numerics match the AD engine
+    # (pinned by tests/test_fused_bwd.py).
+    grad_engine: str = "auto"
 
 
 @dataclass(frozen=True)
@@ -669,6 +680,19 @@ class Config:
                 raise ValueError(
                     f"ce_chunk_size ({t.ce_chunk_size}) must divide the "
                     f"per-tp-shard vocab (vocab_size/tp_size = {vshard})")
+        if t.grad_engine not in ("auto", "ad", "fused"):
+            raise ValueError(
+                f"grad_engine must be auto/ad/fused, got {t.grad_engine!r}")
+        if t.grad_engine == "fused":
+            from picotron_tpu.parallel.fused_bwd import fused_bwd_supported
+
+            if not fused_bwd_supported(self):
+                raise ValueError(
+                    "grad_engine='fused' requires the dense single-stage "
+                    "path: pp_size=cp_size=1, no sequence_parallel, no "
+                    "MoE, remat with remat_policy='dots_attn', and "
+                    "attn_impl in auto/flash/reference (use 'auto' to "
+                    "fall back to the AD engine automatically)")
         if t.optimizer_offload:
             if d.zero1:
                 raise ValueError(

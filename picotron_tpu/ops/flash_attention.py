@@ -670,3 +670,69 @@ def flash_attention(
         # drop the trailing dim at the boundary.
         return out, lse[..., 0]
     return out
+
+
+def flash_attention_bwd_from_saved(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    out: jnp.ndarray,
+    lse: jnp.ndarray,
+    dout: jnp.ndarray,
+    *,
+    causal: bool = True,
+    q_positions: Optional[jnp.ndarray] = None,
+    kv_positions: Optional[jnp.ndarray] = None,
+    sm_scale: Optional[float] = None,
+    rope: Optional[tuple] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
+):
+    """(dq, dk, dv) from the forward's saved tensors — the manual-VJP entry
+    for the fused grad engine (parallel/fused_bwd.py), which saves exactly
+    (q, k, v, out, lse) per layer and never re-runs the forward kernel.
+
+    Shapes follow the public `flash_attention`: q [B, Sq, Hq, D] UNROTATED
+    and UNSCALED (as produced by qkv_proj — the "qkv_out" save set), out
+    [B, Sq, Hq, D], lse [B, Hq, Sq] fp32 (the public return_lse form),
+    dout like out. The sm_scale fold and the head-axis swaps happen here,
+    mirroring `flash_attention`'s pre-kernel steps, so callers hold only
+    the flat matmul-layout tensors. The LSE cotangent is zero by contract
+    (training consumes `out` only).
+
+    On non-TPU backends this recomputes via AD of the public entry — the
+    same sdpa fallback dispatch, so CPU-mesh parity tests exercise the
+    identical math.
+    """
+    b, sq, hq, d = q.shape
+    sk = k.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+    if interpret is None and jax.default_backend() != "tpu":
+        def f(q_, k_, v_):
+            return flash_attention(
+                q_, k_, v_, causal=causal, q_positions=q_positions,
+                kv_positions=kv_positions, sm_scale=sm_scale, rope=rope,
+                block_q=block_q, block_k=block_k)
+
+        _, vjp_fn = jax.vjp(f, q, k, v)
+        return vjp_fn(dout)
+    interpret = bool(interpret)
+    qpos = (q_positions if q_positions is not None else jnp.arange(sq))
+    kpos = (kv_positions if kv_positions is not None else jnp.arange(sk))
+    qpos = qpos.astype(jnp.int32).reshape(1, sq)
+    kpos = kpos.astype(jnp.int32).reshape(1, sk)
+    scale = jnp.asarray(sm_scale, q.dtype)
+    q4 = jnp.swapaxes(q, 1, 2) * scale
+    k4 = jnp.swapaxes(k, 1, 2)
+    v4 = jnp.swapaxes(v, 1, 2)
+    o4 = jnp.swapaxes(out, 1, 2)
+    do4 = jnp.swapaxes(dout, 1, 2)
+    lse4 = lse[..., None]
+    dq4, dk4, dv4 = _bwd(q4, k4, v4, o4, lse4, do4, jnp.zeros_like(lse4),
+                         qpos, kpos, rope, 1.0, causal, block_q, block_k,
+                         interpret)
+    # chain rule through the q * sm_scale fold
+    dq = jnp.swapaxes(dq4, 1, 2) * scale
+    return dq, jnp.swapaxes(dk4, 1, 2), jnp.swapaxes(dv4, 1, 2)

@@ -277,6 +277,16 @@ def _device_grads(params, batch, cfg: Config):
         count = jnp.maximum(lax.psum(count, ("dp", "ep", "cp")), 1)
         return _finish_grads(grads, nll_total, count, dropw, cfg)
 
+    from picotron_tpu.parallel.fused_bwd import (
+        fused_bwd_supported, fused_micro_grads,
+    )
+
+    t = cfg.training
+    use_fused = (t.grad_engine == "fused"
+                 or (t.grad_engine == "auto"
+                     and t.gradient_accumulation_steps > 1
+                     and fused_bwd_supported(cfg)))
+
     def nll_sum(params, mb_ids, mb_tgt):
         total, count, extras = loss_sum_count(params, mb_ids, mb_tgt,
                                               cfg.model, ctx)
@@ -286,6 +296,12 @@ def _device_grads(params, batch, cfg: Config):
     def micro_step(carry, mb):
         g_acc, l_acc, c_acc, d_acc = carry
         mb_ids, mb_tgt = mb
+        if use_fused:
+            # manual backward layer scan accumulating dW in-scan: no
+            # per-microbatch grad tree, no whole-tree adds (fused_bwd.py)
+            g_acc, total, count = fused_micro_grads(
+                params, mb_ids, mb_tgt, g_acc, cfg, ctx)
+            return (g_acc, l_acc + total, c_acc + count, d_acc), None
         (total, (count, dropw)), grads = jax.value_and_grad(
             nll_sum, has_aux=True)(params, mb_ids, mb_tgt)
         return (jax.tree.map(jnp.add, g_acc, grads), l_acc + total,

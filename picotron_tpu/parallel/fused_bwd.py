@@ -1,0 +1,224 @@
+"""Fused-accumulation grad engine: a manual VJP over the decoder-layer scan
+that adds each layer's weight gradients into the fp32 accumulator IN-SCAN.
+
+Why this exists (PERF.md r5): under gradient accumulation the AD path
+materializes every microbatch's full stacked-layer grad tree (the backward
+scan's ys output, ~6.5 GB fp32 at SmolLM-1.7B) and then runs whole-tree
+`g_acc + grads` adds — measured at 26 ms per microbatch of pure serialized
+HBM traffic between the backward and the next forward scan (1.7 s of a 36 s
+step at grad-acc 64, all at roofline, none of it overlappable: TPU cores
+run one op at a time, and the adds depend on the completed backward-scan
+output buffer). This engine instead carries the fp32 accumulator through a
+manual backward layer scan and updates one layer's slices per iteration
+(`dynamic-update-slice(acc, acc[k] + dW_k)`), so the microbatch grad tree
+never exists — the temp write AND the separate add pass disappear.
+
+The backward mirrors exactly the `dots_attn` remat policy's save set
+(models/llama.py remat_policy_for): the forward scan saves per layer the
+layer input x plus the flash kernel's residuals (q/k/v flat "qkv_out",
+out flat "attn_out", "attn_lse"); the backward recomputes the norms, the
+o-projection input, and the whole MLP, and reaches the Pallas backward
+kernels through `flash_attention_bwd_from_saved` without re-running the
+forward kernel. Segment VJPs (`jax.vjp` over the same llama.py building
+blocks — qkv_proj, _mlp_block, the ctx.f/g hooks) derive every other
+transpose, so TP collectives and activation functions cannot diverge from
+the AD engine; parity is pinned by tests/test_fused_bwd.py.
+
+Eligibility (see `fused_bwd_supported`): the single-stage dense path —
+pp = cp = 1, no MoE, no sequence parallelism, remat with the dots_attn
+policy, flash/sdpa attention. Everything else keeps the AD engine; the
+reference has no analogue of either (its per-rank autograd accumulates
+into .grad buffers in place, ref: bucket.py:25-31 — an imperative luxury
+an SPMD program has to earn back with scan structure).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from picotron_tpu.config import Config
+from picotron_tpu.models.llama import (
+    ParallelCtx, _mlp_block, compute_dtype, head_weight, model_rope_tables,
+    qkv_proj,
+)
+from picotron_tpu.ops.flash_attention import (
+    flash_attention, flash_attention_bwd_from_saved,
+)
+from picotron_tpu.ops.rmsnorm import rms_norm
+
+
+def fused_bwd_supported(cfg: Config) -> bool:
+    """True when the fused grad engine covers this config (the dense
+    single-stage path whose save set is exactly dots_attn's)."""
+    d, m, t = cfg.distributed, cfg.model, cfg.training
+    return (d.pp_size == 1 and d.cp_size == 1
+            and not d.sequence_parallel
+            and not m.num_experts
+            and t.remat and t.remat_policy == "dots_attn"
+            and m.attn_impl in ("auto", "flash", "reference"))
+
+
+def _vary_like(x, ref):
+    want = set(jax.typeof(ref).vma) - set(jax.typeof(x).vma)
+    return (lax.pcast(x, tuple(sorted(want)), to="varying") if want
+            else x)
+
+
+def fused_micro_grads(params, ids, tgt, g_acc, cfg: Config,
+                      ctx: ParallelCtx):
+    """One microbatch: returns (g_acc', nll_sum, valid_count) with grads
+    accumulated into g_acc (layer leaves in-scan, non-layer leaves by one
+    small add). Per-device semantics — runs inside the train step's
+    shard_map body like the AD engine it replaces. Numerics match the AD
+    engine: per-layer dW emerges in the bf16 param dtype from the same
+    segment math before the fp32 accumulate."""
+    m = cfg.model
+    eps = m.rms_norm_eps
+    hd = m.head_dim
+    cos, sin = model_rope_tables(m)
+    pos = ctx.positions
+    use_flash = m.attn_impl in ("auto", "flash")
+
+    def attn_fwd(q, k, v):
+        if use_flash:
+            return flash_attention(q, k, v, causal=True, rope=(cos, sin),
+                                   q_positions=pos, kv_positions=pos,
+                                   return_lse=True)
+        from picotron_tpu.ops.attention import sdpa_attention
+        from picotron_tpu.ops.rope import apply_rope
+
+        qr = apply_rope(q, cos, sin, pos)
+        kr = apply_rope(k, cos, sin, pos)
+        return sdpa_attention(qr, kr, v, causal=True, q_positions=pos,
+                              kv_positions=pos, return_lse=True)
+
+    def attn_bwd(qf, kf, vf, outf, lse, doutf):
+        b, s, _ = qf.shape
+        r = lambda t: t.reshape(b, s, -1, hd)  # noqa: E731
+        if use_flash:
+            dq, dk, dv = flash_attention_bwd_from_saved(
+                r(qf), r(kf), r(vf), r(outf), lse, r(doutf), causal=True,
+                q_positions=pos, kv_positions=pos, rope=(cos, sin))
+        else:
+            def f(q, k, v):
+                out, _ = attn_fwd(q, k, v)
+                return out
+
+            _, vjp_fn = jax.vjp(f, r(qf), r(kf), r(vf))
+            dq, dk, dv = vjp_fn(r(doutf))
+        flat = lambda t: t.reshape(b, s, -1)  # noqa: E731
+        return flat(dq), flat(dk), flat(dv)
+
+    bias_keys = [k for k in ("b_q", "b_k", "b_v")
+                 if k in params["layers"]]
+
+    # ---------------- forward ----------------
+    x0, vjp_embed = jax.vjp(
+        lambda e: (ctx.embed_lookup(e, ids) if ctx.embed_lookup is not None
+                   else e[ids]).astype(compute_dtype(m)),
+        params["embedding"])
+
+    def fwd_body(x, lp):
+        b, s, _ = x.shape
+        h1 = rms_norm(x, lp["input_norm"], eps)
+        hf = ctx.f(h1)
+        q, k, v = qkv_proj(hf, lp, hd)
+        out, lse = attn_fwd(q, k, v)
+        outf = out.reshape(b, s, -1)
+        a = x + ctx.g(outf @ lp["o"].astype(x.dtype))
+        y = a + _mlp_block(a, lp, m, ctx)
+        flat = lambda t: t.reshape(b, s, -1)  # noqa: E731
+        return y, (x, flat(q), flat(k), flat(v), outf, lse)
+
+    xL, saved = lax.scan(fwd_body, x0, params["layers"])
+
+    # ---------------- head + CE ----------------
+    nonlayer = {k: v for k, v in params.items() if k != "layers"}
+
+    def head_fn(x, nl):
+        xh = rms_norm(x, nl["final_norm"], eps)
+        if ctx.head_ce is not None:
+            total, count = ctx.head_ce(xh, head_weight(nl), tgt)
+        else:
+            from picotron_tpu.ops.losses import cross_entropy_sum_count
+
+            logits = xh @ head_weight(nl).astype(xh.dtype)
+            total, count = cross_entropy_sum_count(logits, tgt)
+        return total, count
+
+    (total, vjp_head, count) = jax.vjp(head_fn, xL, nonlayer, has_aux=True)
+    one = _vary_like(jnp.ones((), jnp.float32), total)
+    dxL, g_nonlayer = vjp_head(one)
+
+    # ---------------- backward layer scan ----------------
+    def bwd_body(carry, xs):
+        dy, gL = carry
+        (x, qf, kf, vf, outf, lse), lp, idx = xs
+        b, s, _ = x.shape
+
+        # MLP half: recompute a = x + o-proj (the dots_attn policy's
+        # recompute set), derive the MLP/post-norm grads by segment VJP
+        a = x + ctx.g(outf @ lp["o"].astype(x.dtype))
+
+        def seg_mlp(a_, w_post, wg, wu, wd):
+            lp2 = dict(lp)
+            lp2.update(post_norm=w_post, gate=wg, up=wu, down=wd)
+            return a_ + _mlp_block(a_, lp2, m, ctx)
+
+        _, vjp_b = jax.vjp(seg_mlp, a, lp["post_norm"], lp["gate"],
+                           lp["up"], lp["down"])
+        da, d_post, d_gate, d_up, d_down = vjp_b(dy)
+
+        def seg_o(x_, outf_, wo):
+            return x_ + ctx.g(outf_ @ wo.astype(x_.dtype))
+
+        _, vjp_o = jax.vjp(seg_o, x, outf, lp["o"])
+        dx1, doutf, d_o = vjp_o(da)
+
+        dqf, dkf, dvf = attn_bwd(qf, kf, vf, outf, lse, doutf)
+
+        def seg_qkv(x_, w_in, wq, wk, wv, *bs):
+            lpq = dict(lp)
+            lpq.update(input_norm=w_in, q=wq, k=wk, v=wv,
+                       **dict(zip(bias_keys, bs)))
+            h1_ = rms_norm(x_, w_in, eps)
+            hf_ = ctx.f(h1_)
+            q_, k_, v_ = qkv_proj(hf_, lpq, hd)
+            flat = lambda t: t.reshape(b, s, -1)  # noqa: E731
+            return flat(q_), flat(k_), flat(v_)
+
+        _, vjp_q = jax.vjp(seg_qkv, x, lp["input_norm"], lp["q"], lp["k"],
+                           lp["v"], *[lp[k] for k in bias_keys])
+        dx2, d_in, d_q, d_k, d_v, *d_bs = vjp_q((dqf, dkf, dvf))
+
+        gl = dict(input_norm=d_in, q=d_q, k=d_k, v=d_v, o=d_o,
+                  post_norm=d_post, gate=d_gate, up=d_up, down=d_down,
+                  **dict(zip(bias_keys, d_bs)))
+        assert set(gl) == set(lp), (sorted(gl), sorted(lp))
+
+        def acc(accl, g):
+            cur = lax.dynamic_index_in_dim(accl, idx, 0, keepdims=False)
+            return lax.dynamic_update_index_in_dim(
+                accl, cur + g.astype(accl.dtype), idx, 0)
+
+        gL = jax.tree.map(acc, gL, gl)
+        return (dx1 + dx2, gL), None
+
+    n_layers = jax.tree.leaves(params["layers"])[0].shape[0]
+    (dx0, g_layers), _ = lax.scan(
+        bwd_body, (dxL, g_acc["layers"]),
+        (saved, params["layers"], jnp.arange(n_layers)), reverse=True)
+
+    # ---------------- embedding + non-layer accumulate ----------------
+    (g_embed,) = vjp_embed(dx0)
+    new_acc = {"layers": g_layers}
+    for k in g_acc:
+        if k == "layers":
+            continue
+        g = g_nonlayer[k]
+        if k == "embedding":
+            g = g + g_embed if g is not None else g_embed
+        new_acc[k] = g_acc[k] + g.astype(g_acc[k].dtype)
+    return new_acc, total, count

@@ -27,7 +27,6 @@ import argparse
 import dataclasses
 import json
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -73,6 +72,28 @@ def analyze(cfg, mbs=None) -> dict:
     }
 
 
+def _field_is_str(dotted: str) -> bool:
+    """True when the dotted config path names a str (or Optional[str])
+    dataclass field — the cases where a bare-string --override value is
+    legitimate. Unknown paths return False (loud beats silent)."""
+    import typing
+
+    from picotron_tpu import config as cfg_mod
+
+    cls = cfg_mod.Config
+    parts = dotted.split(".")
+    try:
+        for p in parts[:-1]:
+            cls = typing.get_type_hints(cls)[p]
+        t = typing.get_type_hints(cls)[parts[-1]]
+    except (KeyError, TypeError):
+        return False
+    if t is str:
+        return True
+    return (typing.get_origin(t) is typing.Union
+            and str in typing.get_args(t))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description="picotron-tpu memory analysis")
     ap.add_argument("--config", required=True)
@@ -105,23 +126,20 @@ def main() -> None:
             try:
                 node[key] = json.loads(val)  # true/false/numbers/lists
             except ValueError:
-                # identifier-like bare strings stay strings: `--override
-                # training.remat_policy=dots_attn` must not demand shell-
-                # quoted embedded JSON quotes (ADVICE r4). Anything else
-                # (a typo'd literal like `flase`, broken JSON) stays a
-                # loud error — a truthy string silently flipping a bool
-                # knob ON would measure the wrong config (code review r5).
-                prev = node.get(key)
-                if isinstance(prev, (bool, int, float)) \
-                        or not re.fullmatch(r"[A-Za-z_][A-Za-z0-9_./-]*",
-                                            val) \
-                        or val in ("True", "False", "None"):
-                    # a typo'd literal (`zero1=flase`) must not become a
-                    # truthy string that silently flips a non-string knob
+                # Bare strings stay strings for STRING-TYPED knobs:
+                # `--override training.remat_policy=dots_attn` must not
+                # demand shell-quoted embedded JSON quotes (ADVICE r4).
+                # The knob's declared dataclass type decides — a typo'd
+                # literal on a bool/number knob (`zero1=flase`) must stay
+                # a loud error, not a truthy string that silently flips
+                # the knob ON and measures the wrong config (code review
+                # r5; checking the raw JSON's existing value instead
+                # misses every key the config file omits as defaulted).
+                if not _field_is_str(dotted):
                     raise SystemExit(
                         f"--override {dotted}={val!r}: not valid JSON, "
-                        f"and the existing value "
-                        f"({prev!r}) is not a string")
+                        f"and {dotted} is not a string-typed config "
+                        f"field")
                 node[key] = val
         tmp = tempfile.NamedTemporaryFile(
             "w", suffix=".json", delete=False)
