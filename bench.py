@@ -35,6 +35,17 @@ SWEEP = [
     ("SmolLM-1.7B", 8, 4096, 2, {}),
     ("SmolLM-1.7B", 4, 16384, 1, {}),     # long-context: blocked-KV flash
     ("SmolLM-1.7B", 8, 2048, 5, {}),      # depth-reduced peak-MFU config
+    # Llama-2-7B per-layer anchor (the reference's second published
+    # figure is 38% at 7B on 64xH100, ref README.md:7): 4 of 32 layers
+    # fit one chip with offload; per-layer work (h=4096, 11008-wide MLP,
+    # MHA 32:32) is identical to the full model
+    ("Llama-2-7B", 4, 4096, 2,
+     dict(grad_acc=16, remat_policy="dots_attn", optimizer_offload=True)),
+    # MoE on hardware (the reference has no MoE): Mixtral-8x7B's full
+    # 1.41 B-param expert bank at 1 layer — GShard capacity dispatch +
+    # the streamed update over the [E, H, I] banks (ep=1 on one chip)
+    ("Mixtral-8x7B", 1, 2048, 2,
+     dict(grad_acc=64, remat_policy="dots", optimizer_offload=True)),
     # FULL depth at seq 4096 — long context + optimizer offload compose
     # (row-group update streaming keeps the embedding/lm_head transients
     # off the peak; PERF.md r4)

@@ -38,6 +38,31 @@ from picotron_tpu.config import Config, ModelConfig
 from picotron_tpu.train_step import TrainState
 
 
+def _isdir(path: str) -> bool:
+    """Directory probe through epath (Orbax's own path layer) so
+    URL-style stores (gs://) answer correctly — os.path.isdir is always
+    False on URL paths, which would classify every remote checkpoint as
+    not-durable and silently disable auto-resume (code review r5)."""
+    try:
+        from etils import epath
+
+        return epath.Path(path).is_dir()
+    except ImportError:
+        return os.path.isdir(path)
+
+
+def _listdir(path: str) -> list:
+    """Child names of a directory, [] when absent — epath-first for the
+    same URL-store reason as _isdir."""
+    try:
+        from etils import epath
+
+        root = epath.Path(path)
+        return [p.name for p in root.iterdir()] if root.is_dir() else []
+    except ImportError:
+        return os.listdir(path) if os.path.isdir(path) else []
+
+
 # ---------------------------------------------------------------------------
 # Orbax-backed training-state checkpointing
 # ---------------------------------------------------------------------------
@@ -120,19 +145,8 @@ class CheckpointManager:
         the write is still in flight, so a bare isdir test would hand
         restore a torn checkpoint; code review r3)."""
         state_dir = os.path.join(self.directory, step_dirname, "state")
-        try:
-            from etils import epath
-
-            # epath (Orbax's own path layer) so gs://-style directories
-            # probe correctly — os.path.isdir is always False on URL
-            # paths, which would classify every remote checkpoint as
-            # not-durable and silently disable auto-resume (code review
-            # r5)
-            if not epath.Path(state_dir).is_dir():
-                return False
-        except ImportError:
-            if not os.path.isdir(state_dir):
-                return False
+        if not _isdir(state_dir):
+            return False
         try:
             return bool(self._ocp.utils.is_checkpoint_finalized(state_dir))
         except ValueError as e:
@@ -167,18 +181,7 @@ class CheckpointManager:
         """Newest *durable* checkpoint step. An async save that has not
         committed yet (or a crashed one) is skipped rather than handed to
         restore (see _is_durable)."""
-        try:
-            from etils import epath
-
-            # epath so URL-style stores (gs://) enumerate too —
-            # os.listdir would silently find nothing there and disable
-            # auto-resume (code review r5)
-            root = epath.Path(self.directory)
-            names = ([p.name for p in root.iterdir()]
-                     if root.is_dir() else [])
-        except ImportError:
-            names = (os.listdir(self.directory)
-                     if os.path.isdir(self.directory) else [])
+        names = _listdir(self.directory)
         steps = [
             int(m.group(1))
             for d in names

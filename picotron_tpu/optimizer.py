@@ -362,6 +362,21 @@ def offload_adam_update(grads, state: OffloadAdamState, t: TrainingConfig,
     p_leaves = treedef.flatten_up_to(state.master)
     m_leaves = treedef.flatten_up_to(state.mu)
     n_leaves = treedef.flatten_up_to(state.nu)
+    # Squeeze leading unit dims so single-layer stacks still stream: a
+    # 1-layer model's stacked expert bank is [1, E, H, I] — axis 0 of
+    # size 1 would fall through to leaf_whole and put the entire
+    # multi-GB master in flight at once (measured: the Mixtral-8x7B-1L
+    # row OOM'd by 2.6 GB, PERF.md r5). Dropping the unit dim is a
+    # layout-preserving view (unlike the dim-folding reshapes that kill
+    # the async-DMA fast path), so the bank streams along its expert
+    # axis; outputs reshape back below.
+    lead1 = [p.ndim >= 3 and p.shape[0] == 1 for p in p_leaves]
+    if transfer:
+        sq = lambda t: t.reshape(t.shape[1:])  # noqa: E731
+        p_leaves = [sq(p) if s else p for p, s in zip(p_leaves, lead1)]
+        m_leaves = [sq(m) if s else m for m, s in zip(m_leaves, lead1)]
+        n_leaves = [sq(n) if s else n for n, s in zip(n_leaves, lead1)]
+        g_leaves = [sq(g) if s else g for g, s in zip(g_leaves, lead1)]
     # collect the scannable leaves into same-(vma, depth) groups so each
     # group streams as one fused scan (group_scanned)
     groups: dict = {}
@@ -390,6 +405,9 @@ def offload_adam_update(grads, state: OffloadAdamState, t: TrainingConfig,
         else:
             o, tokens[key] = leaf_whole(g, p_h, m_h, n_h, token)
             out[i] = o
+    if transfer and any(lead1):
+        out = [tuple(t.reshape((1,) + t.shape) for t in o) if s else o
+               for o, s in zip(out, lead1)]
     pick = lambda i: jax.tree.unflatten(  # noqa: E731
         treedef, [o[i] for o in out])
     new_state = OffloadAdamState(count=count, master=pick(0), mu=pick(1),

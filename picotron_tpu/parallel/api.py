@@ -307,26 +307,53 @@ def _device_grads(params, batch, cfg: Config):
         return (jax.tree.map(jnp.add, g_acc, grads), l_acc + total,
                 c_acc + count, d_acc + dropw), None
 
-    # The accumulators become dp/ep/cp-varying inside the scan (they depend
-    # on this device's batch shard), so the initial carry must carry the
-    # same varying type. Promote per leaf, skipping axes a leaf already
-    # varies over (expert banks arrive ep-varying from their sharding).
-    from picotron_tpu.parallel.pp import _vary_over
+    d = cfg.distributed
+    if ids.shape[0] == 1 and not use_fused:
+        # Single-microbatch fast path: differentiate directly — the
+        # accumulation scan's fp32 zeros carry + per-microbatch grad temp
+        # would hold TWO full grad trees for zero numerical effect
+        # (add(0.0f32, bf16 g) is an exact promotion). At MoE scale the
+        # double tree is the difference between fitting and OOM: the
+        # Mixtral-8x7B single-chip row needs this path (PERF.md r5).
+        # (An explicit grad_engine='fused' still takes the scan path —
+        # silently swapping engines under the user would invalidate any
+        # ga=1 A/B measurement; code review r5.)
+        (nll_total, (count, dropw)), grads = jax.value_and_grad(
+            nll_sum, has_aux=True)(params, ids[0], tgt[0])
+        if (not cfg.training.optimizer_offload
+                or d.dp_size * d.ep_size * d.cp_size > 1):
+            # fp32 BEFORE the data-axes psum: under offload the bf16
+            # params yield bf16 grads, and a multi-shard all-reduce in
+            # bf16 would drop exactly the low bits the fp32 master keeps
+            # (the accumulation path promotes via its fp32 carry; code
+            # review r5). Single-shard offload keeps the bf16 tree — the
+            # psum is an identity there and the streamed update casts
+            # per slice, which is what lets Mixtral-1L fit.
+            grads = jax.tree.map(
+                lambda g: g.astype(jnp.float32), grads)
+    else:
+        # The accumulators become dp/ep/cp-varying inside the scan (they
+        # depend on this device's batch shard), so the initial carry must
+        # carry the same varying type. Promote per leaf, skipping axes a
+        # leaf already varies over (expert banks arrive ep-varying from
+        # their sharding).
+        from picotron_tpu.parallel.pp import _vary_over
 
-    # fp32 accumulation regardless of the param dtype: with optimizer_offload
-    # the params (hence per-microbatch grads) are bf16; summing grad-acc
-    # microbatches in bf16 would lose exactly the low bits the fp32 master
-    # exists to keep (jnp.add promotes bf16 + fp32 -> fp32).
-    zeros = jax.tree.map(
-        lambda p: _vary_over(jnp.zeros(p.shape, jnp.float32),
-                             {"dp", "ep", "cp"} | set(jax.typeof(p).vma)),
-        params)
-    init_carry = (zeros,) + lax.pcast(
-        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32),
-         jnp.zeros((), jnp.float32)),
-        ("dp", "ep", "cp"), to="varying")
-    (grads, nll_total, count, dropw), _ = lax.scan(
-        micro_step, init_carry, (ids, tgt))
+        # fp32 accumulation regardless of the param dtype: with
+        # optimizer_offload the params (hence per-microbatch grads) are
+        # bf16; summing grad-acc microbatches in bf16 would lose exactly
+        # the low bits the fp32 master exists to keep (jnp.add promotes
+        # bf16 + fp32 -> fp32).
+        zeros = jax.tree.map(
+            lambda p: _vary_over(jnp.zeros(p.shape, jnp.float32),
+                                 {"dp", "ep", "cp"} | set(jax.typeof(p).vma)),
+            params)
+        init_carry = (zeros,) + lax.pcast(
+            (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32),
+             jnp.zeros((), jnp.float32)),
+            ("dp", "ep", "cp"), to="varying")
+        (grads, nll_total, count, dropw), _ = lax.scan(
+            micro_step, init_carry, (ids, tgt))
     # gradient + loss sync over the fused data axes (the reference's cp_dp
     # group semantics: ref process_group_manager.py:22, utils.py:93-98)
     grads = _data_axes_psum(grads, cfg)

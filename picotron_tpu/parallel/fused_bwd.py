@@ -61,9 +61,9 @@ def fused_bwd_supported(cfg: Config) -> bool:
 
 
 def _vary_like(x, ref):
-    want = set(jax.typeof(ref).vma) - set(jax.typeof(x).vma)
-    return (lax.pcast(x, tuple(sorted(want)), to="varying") if want
-            else x)
+    from picotron_tpu.parallel.pp import _vary_over
+
+    return _vary_over(x, set(jax.typeof(ref).vma))
 
 
 def fused_micro_grads(params, ids, tgt, g_acc, cfg: Config,

@@ -117,6 +117,19 @@ def test_loss_parity_with_baseline():
     assert l_off[-1] < l_off[0]  # it optimizes
 
 
+def test_loss_parity_single_microbatch():
+    """ga=1 takes the direct (no-accumulation-scan) path — on the dp2xtp2
+    mesh its grads must fp32-promote BEFORE the data-axes psum, tracking
+    the baseline exactly like the scan path does (code review r5)."""
+    l_base, _, _ = run_steps(offload_cfg(offload=False,
+                                         gradient_accumulation_steps=1))
+    l_off, _, _ = run_steps(offload_cfg(offload=True,
+                                        gradient_accumulation_steps=1))
+    assert l_base[0] == pytest.approx(l_off[0], abs=1e-6)
+    for a, b in zip(l_base, l_off):
+        assert a == pytest.approx(b, abs=5e-3)
+
+
 def test_update_math_matches_optax_chain():
     """Given identical fp32 grads, the streamed AdamW must reproduce the
     on-device optax chain (clip -> bf16-moment adam -> weight decay -> lr)

@@ -135,11 +135,16 @@ def main() -> None:
                 # the knob ON and measures the wrong config (code review
                 # r5; checking the raw JSON's existing value instead
                 # misses every key the config file omits as defaulted).
-                if not _field_is_str(dotted):
+                if not _field_is_str(dotted) \
+                        or val in ("True", "False", "None"):
+                    # Python-literal spellings stay loud even on string
+                    # knobs: `run_name=None` means JSON null, not the
+                    # string "None" (code review r5)
                     raise SystemExit(
                         f"--override {dotted}={val!r}: not valid JSON, "
-                        f"and {dotted} is not a string-typed config "
-                        f"field")
+                        f"and {dotted} is not a plain-string config "
+                        f"field (use JSON: quotes for strings, "
+                        f"true/false/null lowercase)")
                 node[key] = val
         tmp = tempfile.NamedTemporaryFile(
             "w", suffix=".json", delete=False)
