@@ -275,9 +275,7 @@ def restore_params_only(cfg: Config, ckpt_dir: str,
     import orbax.checkpoint as ocp
 
     from picotron_tpu.mesh import MeshEnv
-    from picotron_tpu.models.llama import (
-        init_params, pad_layers_for_pp, unpad_layers,
-    )
+    from picotron_tpu.models.llama import unpad_layers
 
     menv = MeshEnv.create(dp=1, devices=jax.devices()[:1])
     mgr = CheckpointManager(cfg, menv, directory=ckpt_dir)
@@ -285,10 +283,10 @@ def restore_params_only(cfg: Config, ckpt_dir: str,
         step = mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    from picotron_tpu.parallel.api import abstract_master
+
     nl, pp = cfg.model.num_hidden_layers, cfg.distributed.pp_size
-    abstract = jax.eval_shape(
-        lambda: pad_layers_for_pp(init_params(cfg.model, jax.random.key(0)),
-                                  nl, pp))
+    abstract = abstract_master(cfg)
     sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
     restore_args = jax.tree.map(
         lambda x: ocp.ArrayRestoreArgs(dtype=dtype or x.dtype,

@@ -694,12 +694,10 @@ class Config:
                     "attn_impl in auto/flash/reference (use 'auto' to "
                     "fall back to the AD engine automatically)")
         if t.optimizer_offload:
-            if d.zero1:
-                raise ValueError(
-                    "optimizer_offload and zero1 are mutually exclusive: "
-                    "both re-home the Adam moments (host memory vs. "
-                    "dp-sharded device memory); pick the one that fits "
-                    "your topology")
+            # zero1 COMPOSES with offload (r5): the host master/moments
+            # shard over the fused data axes, each process streams 1/dp
+            # of the state, and the update all-gathers the refreshed
+            # bf16 params — dp x less host RAM and PCIe per process.
             if self.model.dtype != "bfloat16":
                 raise ValueError(
                     "optimizer_offload requires model.dtype='bfloat16' "

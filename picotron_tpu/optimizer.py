@@ -143,7 +143,7 @@ def _global_sq_norm(grads, clip_specs):
 
 def offload_adam_update(grads, state: OffloadAdamState, t: TrainingConfig,
                         compute_dtype, *, transfer: bool = True,
-                        clip_specs=None, grad_scale=None):
+                        clip_specs=None, grad_scale=None, zero1_info=None):
     """One AdamW step streamed through the device, leaf by leaf — written
     in PER-DEVICE terms so it runs INSIDE the train step's shard_map body:
     every operand is this device's local shard, and host<->device movement
@@ -159,7 +159,13 @@ def offload_adam_update(grads, state: OffloadAdamState, t: TrainingConfig,
     placement transfers. clip_specs: the params' PartitionSpec tree, for
     the cross-shard grad-norm psum (None = local norm). grad_scale (e.g.
     1/token_count) is folded into the per-slice math so the caller never
-    materializes a divided copy of the grad tree.
+    materializes a divided copy of the grad tree. zero1_info (from
+    api.offload_zero1_info): per-flattened-leaf (dim, axes, axis_sizes)
+    ZeRO-1 placements — the host state arrives sharded over the fused
+    data axes, so each process slices its shard out of the (replicated)
+    grads, updates 1/dp of the state, and all-gathers the refreshed
+    compute-dtype params back to full size at the end. The math per
+    element is unchanged; zero1 changes WHICH process updates it.
 
     Returns (new_params_compute_dtype, new_state). The math is
     bit-identical to the on-device `scale_by_adam_low_moments` +
@@ -362,6 +368,25 @@ def offload_adam_update(grads, state: OffloadAdamState, t: TrainingConfig,
     p_leaves = treedef.flatten_up_to(state.master)
     m_leaves = treedef.flatten_up_to(state.mu)
     n_leaves = treedef.flatten_up_to(state.nu)
+    # ZeRO-1: slice each leaf's (replicated) grads down to this process's
+    # state shard. The global-norm clip above already consumed the FULL
+    # grad tree, so the clip scale is identical on every shard.
+    if zero1_info is not None:
+        def z1_slice(g, place):
+            if place is None:
+                return g
+            dim, axes, sizes = place
+            idx = jnp.zeros((), jnp.int32)
+            for a, s in zip(axes, sizes):
+                idx = idx * s + lax.axis_index(a)
+            n_shards = 1
+            for s in sizes:
+                n_shards *= s
+            shard = g.shape[dim] // n_shards
+            return lax.dynamic_slice_in_dim(g, idx * shard, shard, dim)
+
+        g_leaves = [z1_slice(g, pl)
+                    for g, pl in zip(g_leaves, zero1_info)]
     # Squeeze leading unit dims so single-layer stacks still stream: a
     # 1-layer model's stacked expert bank is [1, E, H, I] — axis 0 of
     # size 1 would fall through to leaf_whole and put the entire
@@ -408,6 +433,12 @@ def offload_adam_update(grads, state: OffloadAdamState, t: TrainingConfig,
     if transfer and any(lead1):
         out = [tuple(t.reshape((1,) + t.shape) for t in o) if s else o
                for o, s in zip(out, lead1)]
+    # Under zero1 the compute-dtype params leave this function still
+    # SHARDED over the zero1 axes (each process computed only its 1/dp);
+    # the caller re-gathers them with a GSPMD sharding constraint outside
+    # the shard_map — shard_map's varying-axes checker cannot statically
+    # see that an all_gather of per-shard updates is replicated, while
+    # the SPMD partitioner's resharding is invariant by construction.
     pick = lambda i: jax.tree.unflatten(  # noqa: E731
         treedef, [o[i] for o in out])
     new_state = OffloadAdamState(count=count, master=pick(0), mu=pick(1),

@@ -413,25 +413,48 @@ def make_train_step(cfg: Config, menv: MeshEnv):
         # local shard and the host<->device moves are memory-space-only
         # transfers, so the same body is correct on any mesh (each process
         # streams exactly its own host-resident state shards).
+        # ZeRO-1 composition (VERDICT r4 #3): the host master/moments
+        # shard over the fused data axes; each process streams 1/dp of
+        # the state and the update all-gathers the refreshed bf16 params
+        # over dp at the end.
+        z1_info = None
+        mspecs = pspecs
+        if cfg.distributed.zero1:
+            abs_master = abstract_master(cfg)
+            z1_info = offload_zero1_info(cfg, abs_master)
+            sizes = _zero1_sizes(cfg)
+            mspecs = jax.tree.map(
+                lambda s, a: _zero1_spec(s, a.shape, sizes),
+                pspecs, abs_master, is_leaf=lambda x: isinstance(x, P))
+
         def _device_step(params, batch, opt_state):
             grads, loss, extras = _device_grads(params, batch, cfg)
             grad_scale = extras.pop("_grad_scale")
             new_params, new_opt = offload_adam_update(
                 grads, opt_state, cfg.training, cdt, transfer=transfer,
-                clip_specs=pspecs, grad_scale=grad_scale)
+                clip_specs=pspecs, grad_scale=grad_scale,
+                zero1_info=z1_info)
             return new_params, new_opt, loss, extras
 
-        opt_specs = OffloadAdamState(count=P(), master=pspecs, mu=pspecs,
-                                     nu=pspecs)
+        opt_specs = OffloadAdamState(count=P(), master=mspecs, mu=mspecs,
+                                     nu=mspecs)
+        # Under zero1 the refreshed bf16 params leave the shard_map still
+        # sharded over the zero1 axes (out spec = mspecs); the GSPMD
+        # constraint below re-gathers them to the full param layout — the
+        # ZeRO-1 update all-gather, expressed as a resharding.
         fused = jax.shard_map(
             _device_step, mesh=mesh,
             in_specs=(pspecs, (bspec, bspec), opt_specs),
-            out_specs=(pspecs, opt_specs, P(), P()))
+            out_specs=(mspecs, opt_specs, P(), P()))
+        full_shardings = param_shardings(cfg, mesh)
 
         @partial(jax.jit, donate_argnums=(0,))
         def step(state: TrainState, batch):
             new_params, new_opt, loss, extras = fused(
                 state.params, batch, state.opt_state)
+            if cfg.distributed.zero1:
+                new_params = jax.lax.with_sharding_constraint(
+                    new_params, full_shardings)
             metrics = {"loss": loss, **extras}
             return TrainState(new_params, new_opt, state.step + 1), metrics
 
@@ -540,7 +563,7 @@ def init_sharded_state(cfg: Config, menv: MeshEnv, key: jax.Array,
         # partitions the elementwise optimizer update per shard and inserts
         # the update all-gather, i.e. the ZeRO-1 schedule falls out of a
         # sharding annotation instead of a hand-written partitioner.
-        sizes = {"dp": cfg.distributed.dp_size, "ep": cfg.distributed.ep_size}
+        sizes = _zero1_sizes(cfg)
         param_leaf_shardings = [
             NamedSharding(mesh, _zero1_spec(s.spec, p.shape, sizes))
             for p, s in zip(jax.tree.leaves(params), param_leaf_shardings)]
@@ -583,13 +606,12 @@ def _init_offload_state(cfg: Config, menv: MeshEnv, key, init,
     from picotron_tpu.models.llama import compute_dtype
 
     mesh = menv.mesh
-    host_shardings = param_shardings(cfg, mesh,
-                                     memory_kind=offload_memory_kind(mesh))
+    abs_master = abstract_master(cfg)
+    host_shardings = _offload_host_shardings(cfg, mesh, abs_master)
     cdt = compute_dtype(cfg.model)
     mdt = (jnp.bfloat16 if cfg.training.adam_moments_dtype == "bfloat16"
            else jnp.float32)
     replicated = NamedSharding(mesh, P())
-    abs_master = jax.eval_shape(init, key)
 
     if abstract:
         sds = lambda a, dt, s: jax.ShapeDtypeStruct(  # noqa: E731
@@ -640,8 +662,8 @@ def install_params(cfg: Config, menv: MeshEnv, state: TrainState,
         return state._replace(
             params=jax.tree.map(jax.device_put, params, shardings))
     dev_shardings = param_shardings(cfg, menv.mesh)
-    host_shardings = param_shardings(
-        cfg, menv.mesh, memory_kind=offload_memory_kind(menv.mesh))
+    host_shardings = _offload_host_shardings(
+        cfg, menv.mesh, jax.eval_shape(lambda t: t, params))
     master = jax.tree.map(
         lambda p, s: jax.device_put(jnp.asarray(p, jnp.float32), s),
         params, host_shardings)
@@ -653,24 +675,88 @@ def install_params(cfg: Config, menv: MeshEnv, state: TrainState,
                           opt_state=state.opt_state._replace(master=master))
 
 
-def _zero1_spec(spec: P, shape, data_axis_sizes: dict) -> P:
-    """Extend a param's PartitionSpec with the fused data axes ('dp','ep')
-    on the first unsharded, divisible dimension (identity when none
-    qualifies — tiny tensors just stay replicated). Axes the param already
-    shards over (the ep of expert banks) are excluded, matching
+def _zero1_placement(spec: P, shape, data_axis_sizes: dict):
+    """(dim, axes) of the ZeRO-1 shard extension for this leaf, or None
+    when none qualifies: the first unsharded dimension divisible by the
+    product of the applicable fused data axes ('dp','ep'). Axes the param
+    already shards over (the ep of expert banks) are excluded, matching
     _data_axes_psum's view of which axes are data axes per leaf."""
     used = {a for part in spec if part is not None
             for a in (part if isinstance(part, (tuple, list)) else (part,))}
     axes = tuple(a for a in ("dp", "ep")
                  if data_axis_sizes.get(a, 1) > 1 and a not in used)
     if not axes:
-        return spec
+        return None
     factor = 1
     for a in axes:
         factor *= data_axis_sizes[a]
     entries = list(spec) + [None] * (len(shape) - len(spec))
     for i, (entry, dim) in enumerate(zip(entries, shape)):
         if entry is None and dim % factor == 0:
-            entries[i] = axes if len(axes) > 1 else axes[0]
-            return P(*entries)
-    return spec
+            return i, axes
+    return None
+
+
+def _zero1_spec(spec: P, shape, data_axis_sizes: dict) -> P:
+    """Extend a param's PartitionSpec per `_zero1_placement` (identity when
+    no dimension qualifies — tiny tensors just stay replicated)."""
+    place = _zero1_placement(spec, shape, data_axis_sizes)
+    if place is None:
+        return spec
+    dim, axes = place
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    entries[dim] = axes if len(axes) > 1 else axes[0]
+    return P(*entries)
+
+
+def _zero1_sizes(cfg: Config) -> dict:
+    return {"dp": cfg.distributed.dp_size, "ep": cfg.distributed.ep_size}
+
+
+def abstract_master(cfg: Config):
+    """ShapeDtypeStructs of the fp32 master param pytree — the single
+    source of the param tree structure wherever specs must align with the
+    real state leaf-for-leaf (zero1 placements, host shardings,
+    checkpoint templates). Every consumer derives from here so the init
+    expression cannot silently diverge between sites (code review r5)."""
+    return jax.eval_shape(lambda: pad_layers_for_pp(
+        init_params(cfg.model, jax.random.key(0)),
+        cfg.model.num_hidden_layers, cfg.distributed.pp_size))
+
+
+def offload_zero1_info(cfg: Config, abs_master) -> list | None:
+    """Flattened-leaf-aligned list of (dim, axes, axis_sizes) ZeRO-1
+    placements (None per leaf when unsharded) for the offload x zero1
+    composition, or None when zero1 is off. Static — consumed at trace
+    time by optimizer.offload_adam_update for the grad slice / param
+    all-gather."""
+    if not cfg.distributed.zero1:
+        return None
+    sizes = _zero1_sizes(cfg)
+    specs = param_specs(cfg)
+    s_leaves = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    a_leaves = jax.tree.leaves(abs_master)
+    out = []
+    for s, a in zip(s_leaves, a_leaves):
+        place = _zero1_placement(s, a.shape, sizes)
+        out.append(None if place is None else
+                   (place[0], place[1],
+                    tuple(sizes[ax] for ax in place[1])))
+    return out
+
+
+def _offload_host_shardings(cfg: Config, mesh, abs_master):
+    """Host-memory shardings for the offload master/moments. Under zero1
+    they additionally shard over the fused data axes (VERDICT r4 #3 —
+    each process keeps and streams only 1/dp of the host state; the
+    update all-gathers the refreshed bf16 params over dp afterwards)."""
+    kind = offload_memory_kind(mesh)
+    if not cfg.distributed.zero1:
+        return param_shardings(cfg, mesh, memory_kind=kind)
+    kw = {} if kind is None else {"memory_kind": kind}
+    sizes = _zero1_sizes(cfg)
+    return jax.tree.map(
+        lambda spec, a: NamedSharding(
+            mesh, _zero1_spec(spec, a.shape, sizes), **kw),
+        param_specs(cfg), abs_master,
+        is_leaf=lambda x: isinstance(x, P))

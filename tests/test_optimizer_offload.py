@@ -310,13 +310,7 @@ def test_install_params_fills_master_and_compute():
         np.asarray(jax.tree.leaves(new)[0]))
 
 
-def test_offload_rejects_zero1_and_fp32_compute():
-    with pytest.raises(ValueError, match="mutually exclusive"):
-        Config(
-            distributed=DistributedConfig(dp_size=2, zero1=True),
-            model=ModelConfig(),
-            training=TrainingConfig(optimizer_offload=True),
-        ).validate()
+def test_offload_rejects_fp32_compute():
     with pytest.raises(ValueError, match="bfloat16"):
         Config(
             distributed=DistributedConfig(),
@@ -330,3 +324,83 @@ def test_offload_rejects_zero1_and_fp32_compute():
             model=ModelConfig(),
             training=TrainingConfig(optimizer_offload=True),
         ).validate()
+
+
+def test_zero1_composition_parity():
+    """offload x zero1 (VERDICT r4 #3): each process streams 1/dp of the
+    host state and the update all-gathers the refreshed bf16 params —
+    losses and the (re-assembled) master must match plain offload
+    exactly; zero1 changes WHICH process updates an element, never the
+    math."""
+    base = offload_cfg(offload=True, gradient_accumulation_steps=2)
+    z1 = dataclasses.replace(
+        base, distributed=dataclasses.replace(base.distributed, zero1=True))
+    l_base, s_base, _ = run_steps(base)
+    l_z1, s_z1, _ = run_steps(z1)
+    np.testing.assert_allclose(l_z1, l_base, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(s_base.opt_state.master),
+                    jax.tree.leaves(s_z1.opt_state.master)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_zero1_composition_shards_host_state():
+    """The zero1-extended host shardings must actually shard the master
+    over dp (the memory claim), while params stay full-size."""
+    base = offload_cfg(offload=True)
+    z1 = dataclasses.replace(
+        base, distributed=dataclasses.replace(base.distributed, zero1=True))
+    s_base = init_sharded_state(base, MeshEnv.from_config(base),
+                                jax.random.key(0))
+    s_z1 = init_sharded_state(z1, MeshEnv.from_config(z1),
+                              jax.random.key(0))
+
+    def shard_bytes(tree):
+        total = 0
+        for leaf in jax.tree.leaves(tree):
+            shard = leaf.addressable_shards[0].data
+            total += shard.size * shard.dtype.itemsize
+        return total
+
+    # dp=2: the per-device master shard halves for the shardable leaves
+    # (dp x on the big matrices; norms stay replicated)
+    assert shard_bytes(s_z1.opt_state.master) < \
+        0.75 * shard_bytes(s_base.opt_state.master)
+    assert shard_bytes(s_z1.params) == shard_bytes(s_base.params)
+
+
+def test_zero1_composition_with_grad_clip():
+    """The clip consumes the FULL grad tree before the zero1 slice — the
+    scale must be identical on every shard (a sliced norm would diverge
+    per process and desynchronize the replicas)."""
+    base = offload_cfg(offload=True, grad_clip_norm=0.05,
+                       gradient_accumulation_steps=2)
+    z1 = dataclasses.replace(
+        base, distributed=dataclasses.replace(base.distributed, zero1=True))
+    l_base, _, _ = run_steps(base)
+    l_z1, _, _ = run_steps(z1)
+    np.testing.assert_allclose(l_z1, l_base, rtol=1e-6)
+
+
+def test_offload_pp_parity():
+    """offload x pp (VERDICT r4 #4): the per-vma-class update token chains
+    meet pp-sharded stacked leaves and the 1F1B manual-VJP grad path —
+    losses must track the non-offload pp baseline step for step."""
+    base = offload_cfg(offload=False, gradient_accumulation_steps=4)
+    base = dataclasses.replace(
+        base, distributed=DistributedConfig(dp_size=2, pp_size=2,
+                                            pp_engine="1f1b", tp_size=2))
+    off = dataclasses.replace(
+        base, training=dataclasses.replace(base.training,
+                                           optimizer_offload=True))
+    l_base, _, _ = run_steps(base)
+    l_off, s_off, _ = run_steps(off)
+    assert l_base[0] == pytest.approx(l_off[0], abs=1e-6)
+    for a, b in zip(l_base, l_off):
+        assert a == pytest.approx(b, abs=5e-3)
+    assert l_off[-1] < l_off[0]
+    # pp shards the stacked layer leaves: each device's master shard must
+    # hold layers/pp of the stack
+    stacked = s_off.opt_state.master["layers"]["q"]
+    assert stacked.addressable_shards[0].data.shape[0] == \
+        stacked.shape[0] // 2

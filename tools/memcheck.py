@@ -100,14 +100,19 @@ def main() -> None:
     ap.add_argument("--sweep-mbs", type=int, nargs="*", default=None,
                     help="analyze these micro-batch sizes instead of the "
                          "config's")
-    ap.add_argument("--override", nargs="*", default=[],
+    ap.add_argument("--override", nargs="*", default=[], action="append",
                     metavar="SECTION.KEY=VALUE",
                     help="dotted config overrides applied before analysis "
                          "(e.g. distributed.zero1=true "
                          "distributed.sequence_parallel=true) — compare a "
                          "knob's memory effect without writing config "
-                         "variants")
+                         "variants; repeated flags compose")
     args = ap.parse_args()
+    # action=append + nargs=* gives a list per flag occurrence; flatten so
+    # `--override a=1 --override b=2` composes instead of last-flag-wins
+    # (argparse's bare nargs=* semantics silently dropped earlier flags —
+    # a mis-measured config; code review r5)
+    args.override = [ov for group in args.override for ov in group]
 
     from picotron_tpu.config import load_config
     from picotron_tpu.mesh import force_host_device_count
@@ -135,16 +140,19 @@ def main() -> None:
                 # the knob ON and measures the wrong config (code review
                 # r5; checking the raw JSON's existing value instead
                 # misses every key the config file omits as defaulted).
-                if not _field_is_str(dotted) \
-                        or val in ("True", "False", "None"):
+                if val in ("True", "False", "None"):
                     # Python-literal spellings stay loud even on string
                     # knobs: `run_name=None` means JSON null, not the
                     # string "None" (code review r5)
                     raise SystemExit(
+                        f"--override {dotted}={val!r}: Python-literal "
+                        f"spelling — use JSON (true/false/null "
+                        f"lowercase, quotes for strings)")
+                if not _field_is_str(dotted):
+                    raise SystemExit(
                         f"--override {dotted}={val!r}: not valid JSON, "
-                        f"and {dotted} is not a plain-string config "
-                        f"field (use JSON: quotes for strings, "
-                        f"true/false/null lowercase)")
+                        f"and {dotted} is not a string-typed config "
+                        f"field")
                 node[key] = val
         tmp = tempfile.NamedTemporaryFile(
             "w", suffix=".json", delete=False)
