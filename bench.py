@@ -156,6 +156,61 @@ def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
     }
 
 
+def run_decode(model: str, layers, prompt_len: int, max_new: int,
+               batch: int, steps: int = 3) -> dict:
+    """Generation throughput on the chip (the reference is training-only,
+    ref: README.md:2 — this is the beyond-parity feature's number): one
+    JSON line with steady-state decode tokens/s as the headline value plus
+    the prefill rate. The prefill/decode split comes from differencing a
+    max_new=1 run (prefill + one sample) against the full run — the two
+    phases live inside one jitted program, so there is no boundary to
+    time directly."""
+    import numpy as np
+
+    from picotron_tpu.config import ModelConfig, resolve_preset
+    from picotron_tpu.generate import generate, place_for_decode
+    from picotron_tpu.models.llama import init_params
+
+    preset = resolve_preset(model)
+    preset["max_position_embeddings"] = max(
+        preset.get("max_position_embeddings", 0), prompt_len + max_new)
+    if layers:
+        preset["num_hidden_layers"] = layers
+    mcfg = ModelConfig(name=model, **preset)
+    params = jax.jit(
+        lambda k: jax.tree.map(lambda x: x.astype(jnp.bfloat16),
+                               init_params(mcfg, k)))(jax.random.key(0))
+    params = place_for_decode(params, mcfg)
+    prompts = jax.random.randint(jax.random.key(1), (batch, prompt_len),
+                                 0, mcfg.vocab_size)
+
+    def timed(n_new: int) -> float:
+        np.asarray(generate(params, mcfg, prompts, n_new))  # compile
+        best = float("inf")
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            np.asarray(generate(params, mcfg, prompts, n_new))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_prefill = timed(1)
+    t_full = timed(max_new)
+    decode_tps = batch * (max_new - 1) / (t_full - t_prefill)
+    return {
+        "metric": f"decode_{model.split('/')[-1]}"
+                  f"-{mcfg.num_hidden_layers}L",
+        "value": round(decode_tps, 1),
+        "unit": "decode_tokens_per_sec",
+        "prefill_tokens_per_sec": round(batch * prompt_len / t_prefill, 1),
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new,
+        "decode_ms_per_token_per_seq": round(
+            (t_full - t_prefill) / (max_new - 1) * 1e3, 2),
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     # Default (no flags) = the HEADLINE config: the full 24-layer
@@ -196,7 +251,7 @@ def main() -> None:
                          "depth-reduced variant of a big model); pass 0 "
                          "for the preset's full depth. Defaults to 8 for "
                          "the default SmolLM-1.7B only, full depth for any "
-                         "explicitly chosen model")
+                         "explicitly chosen model and for --decode")
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture a jax.profiler trace of the timed steps "
                          "into DIR (open with xprof/tensorboard; see "
@@ -205,7 +260,30 @@ def main() -> None:
     ap.add_argument("--sweep", action="store_true",
                     help="run the breadth matrix (one JSON line per config, "
                          "headline last) instead of a single config")
+    ap.add_argument("--decode", action="store_true",
+                    help="measure generation instead of training: prefill "
+                         "tokens/s + steady-state decode tokens/s on the "
+                         "chip (KV-cache path, generate.py)")
+    ap.add_argument("--batch", type=int, default=8,
+                    help="--decode: sequences decoded in parallel")
+    ap.add_argument("--prompt-len", type=int, default=512,
+                    help="--decode: prefill length")
+    ap.add_argument("--max-new-tokens", type=int, default=128,
+                    help="--decode: decode steps measured")
     args = ap.parse_args()
+
+    if args.decode:
+        if args.sweep or args.profile:
+            ap.error("--decode is its own mode; incompatible with "
+                     "--sweep/--profile")
+        if args.max_new_tokens < 2:
+            # the prefill/decode split differences a max_new=1 run
+            # against the full run — guard BEFORE the expensive compiles
+            ap.error("--decode needs --max-new-tokens >= 2")
+        print(json.dumps(run_decode(
+            args.model, args.layers or 0, args.prompt_len,
+            args.max_new_tokens, args.batch, steps=args.steps)))
+        return
 
     if args.sweep:
         import subprocess
