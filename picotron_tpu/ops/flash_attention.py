@@ -60,6 +60,32 @@ def _pick_block(s: int, preferred: int) -> int:
     return max(b, 1)
 
 
+def _kv_eff(qi, ki, bq: int, bk: int):
+    """Clamp a kv-block index to the last block visible from q-block qi
+    under contiguous causal positions (static_causal index maps): skipped
+    tiles re-address the previous iteration's blocks, so Mosaic elides
+    their DMAs entirely."""
+    return jnp.minimum(ki, (qi * bq + bq - 1) // bk)
+
+
+def _q_eff(qi, ki, bq: int, bk: int, num_q: int):
+    """Clamp a q-block index to the first block that can see kv-block ki
+    (the dkv kernel's mirror of _kv_eff). The upper clamp matters when
+    sk > sq: the last kv blocks see no q block at all, and an unclamped
+    index would address past the q array (code review r5)."""
+    return jnp.minimum(jnp.maximum(qi, (ki * bk) // bq), num_q - 1)
+
+
+def _static_block_classes(qi, ki, bq: int, bk: int):
+    """(visible, full) block classes as integer functions of the program
+    ids — the static_causal twin of the kernels' position-based
+    `max(qpos) >= min(kpos)` / `min(qpos) >= max(kpos)` tests, shared by
+    all three kernels so the class boundaries cannot desynchronize."""
+    visible = qi * bq + bq - 1 >= ki * bk
+    full = qi * bq >= ki * bk + bk - 1
+    return visible, full
+
+
 def _rot_tables(cos, sin, pos, dtype=jnp.float32):
     """Gather the half tables [maxS, d/2] at `pos` [1, S] and lay them out
     full-width for the in-kernel rotate-half:
@@ -103,7 +129,8 @@ def _out_struct(shape, dtype, *operands):
 
 
 def _fwd_kernel(*refs, sm_scale: float, causal: bool, num_kv: int,
-                fused_rope: bool):
+                fused_rope: bool, static_causal: bool = False,
+                block_q: int = 0, block_k: int = 0):
     if fused_rope:
         (qpos_ref, kpos_ref, cq_ref, sq_ref, ck_ref, sk_ref,
          q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
@@ -126,7 +153,17 @@ def _fwd_kernel(*refs, sm_scale: float, causal: bool, num_kv: int,
 
     qpos = qpos_ref[0]                                       # [BQ]
     kpos = kpos_ref[0]                                       # [BK]
-    if causal:
+    if static_causal:
+        # Contiguous-positions fast path: the block classes are integer
+        # functions of the program ids, and the index maps re-point every
+        # skipped tile's kv-side blocks at the previous (visible) blocks,
+        # so skipped programs trigger NO new DMAs — measured ~1.4 us per
+        # skipped program otherwise, ~20% of the whole kernel at seq 16k
+        # where nearly half the rectangular grid is below the causal
+        # diagonal (PERF.md r5).
+        qi = pl.program_id(2)
+        visible, full = _static_block_classes(qi, ki, block_q, block_k)
+    elif causal:
         # Three block classes: fully masked (skip entirely), fully visible
         # (no mask / no -inf guards — the common case, ~(num_kv-1)/2 of the
         # grid), and diagonal-straddling (masked path). Splitting the paths
@@ -197,15 +234,22 @@ def _fwd_kernel(*refs, sm_scale: float, causal: bool, num_kv: int,
 
 
 def _fwd(q4, k4, v4, qpos, kpos, rope, sm_scale, causal, block_q, block_k,
-         interpret):
+         interpret, static_causal=False):
     """q4 [B,Hq,Sq,D]; k4/v4 [B,Hkv,Sk,D]; qpos [1,Sq]; kpos [1,Sk];
-    rope = None or (cos, sin) half tables [maxS, D/2] applied in-kernel."""
+    rope = None or (cos, sin) half tables [maxS, D/2] applied in-kernel.
+    static_causal: positions are known to be plain 0..S-1 — skipped tiles
+    use program-id block classes and DMA-free index maps (_kv_eff)."""
     b, hq, sq, d = q4.shape
     hkv, sk = k4.shape[1], k4.shape[2]
     n_rep = hq // hkv
     bq = _pick_block(sq, block_q)
     bk = _pick_block(sk, block_k)
     num_kv = sk // bk
+
+    def keff(qi, ki):
+        # last kv block any row of q-block qi can see; skipped tiles
+        # re-load it (same block as the previous iteration -> no DMA)
+        return _kv_eff(qi, ki, bq, bk) if static_causal else ki
 
     rope_args, rope_specs = [], []
     if rope is not None:
@@ -215,29 +259,33 @@ def _fwd(q4, k4, v4, qpos, kpos, rope, sm_scale, causal, block_q, block_k,
         rope_specs = [
             pl.BlockSpec((1, bq, d), lambda bi, hi, qi, ki: (0, qi, 0)),
             pl.BlockSpec((1, bq, d), lambda bi, hi, qi, ki: (0, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda bi, hi, qi, ki: (0, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bi, hi, qi, ki: (0, ki, 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda bi, hi, qi, ki: (0, keff(qi, ki), 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda bi, hi, qi, ki: (0, keff(qi, ki), 0)),
         ]
 
     grid = (b, hq, sq // bq, num_kv)
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal, num_kv=num_kv,
-        fused_rope=rope is not None)
+        fused_rope=rope is not None, static_causal=static_causal,
+        block_q=bq, block_k=bk)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq), lambda bi, hi, qi, ki: (0, qi)),  # qpos
-            pl.BlockSpec((1, bk), lambda bi, hi, qi, ki: (0, ki)),  # kpos
+            pl.BlockSpec((1, bk),
+                         lambda bi, hi, qi, ki: (0, keff(qi, ki))),  # kpos
             *rope_specs,
             pl.BlockSpec((1, 1, bq, d),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, bk, d),
                          lambda bi, hi, qi, ki, n_rep=n_rep:
-                         (bi, hi // n_rep, ki, 0)),
+                         (bi, hi // n_rep, keff(qi, ki), 0)),
             pl.BlockSpec((1, 1, bk, d),
                          lambda bi, hi, qi, ki, n_rep=n_rep:
-                         (bi, hi // n_rep, ki, 0)),
+                         (bi, hi // n_rep, keff(qi, ki), 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
@@ -269,7 +317,8 @@ def _fwd(q4, k4, v4, qpos, kpos, rope, sm_scale, causal, block_q, block_k,
 
 
 def _bwd_dq_kernel(*refs, sm_scale: float, causal: bool, num_kv: int,
-                   fused_rope: bool):
+                   fused_rope: bool, static_causal: bool = False,
+                   block_q: int = 0, block_k: int = 0):
     if fused_rope:
         (qpos_ref, kpos_ref, cq_ref, sq_ref, ck_ref, sk_ref,
          q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -287,7 +336,12 @@ def _bwd_dq_kernel(*refs, sm_scale: float, causal: bool, num_kv: int,
 
     qpos = qpos_ref[0]
     kpos = kpos_ref[0]
-    if causal:
+    if static_causal:
+        # program-id block classes + DMA-free skipped tiles (_kv_eff) —
+        # see _fwd_kernel's static_causal note
+        qi = pl.program_id(2)
+        visible, full = _static_block_classes(qi, ki, block_q, block_k)
+    elif causal:
         visible = jnp.max(qpos) >= jnp.min(kpos)
         full = jnp.min(qpos) >= jnp.max(kpos)
     else:
@@ -348,7 +402,8 @@ def _bwd_dq_kernel(*refs, sm_scale: float, causal: bool, num_kv: int,
 
 
 def _bwd_dkv_kernel(*refs, sm_scale: float, causal: bool, num_inner: int,
-                    fused_rope: bool):
+                    fused_rope: bool, static_causal: bool = False,
+                    block_q: int = 0, block_k: int = 0, num_q: int = 0):
     if fused_rope:
         (qpos_ref, kpos_ref, cq_ref, sq_ref, ck_ref, sk_ref,
          q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -371,7 +426,13 @@ def _bwd_dkv_kernel(*refs, sm_scale: float, causal: bool, num_inner: int,
 
     qpos = qpos_ref[0]
     kpos = kpos_ref[0]
-    if causal:
+    if static_causal:
+        # program-id block classes + DMA-free skipped tiles (_q_eff) —
+        # see _fwd_kernel's static_causal note
+        ki = pl.program_id(2)
+        qi = t % num_q
+        visible, full = _static_block_classes(qi, ki, block_q, block_k)
+    elif causal:
         visible = jnp.max(qpos) >= jnp.min(kpos)
         full = jnp.min(qpos) >= jnp.max(kpos)
     else:
@@ -435,7 +496,7 @@ def _bwd_dkv_kernel(*refs, sm_scale: float, causal: bool, num_inner: int,
 
 
 def _bwd(q4, k4, v4, o4, lse, do4, dlse, qpos, kpos, rope, sm_scale, causal,
-         block_q, block_k, interpret):
+         block_q, block_k, interpret, static_causal=False):
     b, hq, sq, d = q4.shape
     hkv, sk = k4.shape[1], k4.shape[2]
     n_rep = hq // hkv
@@ -443,6 +504,12 @@ def _bwd(q4, k4, v4, o4, lse, do4, dlse, qpos, kpos, rope, sm_scale, causal,
     bk = _pick_block(sk, block_k)
     num_q = sq // bq
     num_kv = sk // bk
+
+    def keff(qi, ki):
+        return _kv_eff(qi, ki, bq, bk) if static_causal else ki
+
+    def qeff(qi, ki):
+        return _q_eff(qi, ki, bq, bk, num_q) if static_causal else qi
 
     rope_args = []
     if rope is not None:
@@ -467,20 +534,23 @@ def _bwd(q4, k4, v4, o4, lse, do4, dlse, qpos, kpos, rope, sm_scale, causal,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          num_kv=num_kv, fused_rope=rope is not None),
+                          num_kv=num_kv, fused_rope=rope is not None,
+                          static_causal=static_causal, block_q=bq,
+                          block_k=bk),
         grid=(b, hq, num_q, num_kv),
         in_specs=[
             pl.BlockSpec((1, bq), lambda bi, hi, qi, ki: (0, qi)),
-            pl.BlockSpec((1, bk), lambda bi, hi, qi, ki: (0, ki)),
+            pl.BlockSpec((1, bk),
+                         lambda bi, hi, qi, ki: (0, keff(qi, ki))),
             *rope_specs(lambda bi, hi, qi, ki: (0, qi, 0),
-                        lambda bi, hi, qi, ki: (0, ki, 0)),
+                        lambda bi, hi, qi, ki: (0, keff(qi, ki), 0)),
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, bk, d),
                          lambda bi, hi, qi, ki, n_rep=n_rep:
-                         (bi, hi // n_rep, ki, 0)),
+                         (bi, hi // n_rep, keff(qi, ki), 0)),
             pl.BlockSpec((1, 1, bk, d),
                          lambda bi, hi, qi, ki, n_rep=n_rep:
-                         (bi, hi // n_rep, ki, 0)),
+                         (bi, hi // n_rep, keff(qi, ki), 0)),
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
@@ -509,25 +579,34 @@ def _bwd(q4, k4, v4, o4, lse, do4, dlse, qpos, kpos, rope, sm_scale, causal,
     def qblk(t):
         return t % num_q
 
+    def qbe(ki, t):
+        return qeff(qblk(t), ki)
+
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          num_inner=num_inner, fused_rope=rope is not None),
+                          num_inner=num_inner, fused_rope=rope is not None,
+                          static_causal=static_causal, block_q=bq,
+                          block_k=bk, num_q=num_q),
         grid=(b, hkv, num_kv, num_inner),
         in_specs=[
-            pl.BlockSpec((1, bq), lambda bi, hi, ki, t: (0, qblk(t))),
+            pl.BlockSpec((1, bq), lambda bi, hi, ki, t: (0, qbe(ki, t))),
             pl.BlockSpec((1, bk), lambda bi, hi, ki, t: (0, ki)),
-            *rope_specs(lambda bi, hi, ki, t: (0, qblk(t), 0),
+            *rope_specs(lambda bi, hi, ki, t: (0, qbe(ki, t), 0),
                         lambda bi, hi, ki, t: (0, ki, 0)),
             pl.BlockSpec((1, 1, bq, d),
-                         lambda bi, hi, ki, t: (bi, qhead(hi, t), qblk(t), 0)),
+                         lambda bi, hi, ki, t: (bi, qhead(hi, t),
+                                                qbe(ki, t), 0)),
             pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, t: (bi, hi, ki, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, t: (bi, hi, ki, 0)),
             pl.BlockSpec((1, 1, bq, d),
-                         lambda bi, hi, ki, t: (bi, qhead(hi, t), qblk(t), 0)),
+                         lambda bi, hi, ki, t: (bi, qhead(hi, t),
+                                                qbe(ki, t), 0)),
             pl.BlockSpec((1, 1, bq, 1),
-                         lambda bi, hi, ki, t: (bi, qhead(hi, t), qblk(t), 0)),
+                         lambda bi, hi, ki, t: (bi, qhead(hi, t),
+                                                qbe(ki, t), 0)),
             pl.BlockSpec((1, 1, bq, 1),
-                         lambda bi, hi, ki, t: (bi, qhead(hi, t), qblk(t), 0)),
+                         lambda bi, hi, ki, t: (bi, qhead(hi, t),
+                                                qbe(ki, t), 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, t: (bi, hi, ki, 0)),
@@ -558,17 +637,17 @@ def _bwd(q4, k4, v4, o4, lse, do4, dlse, qpos, kpos, rope, sm_scale, causal,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
 def _flash_core(q4, k4, v4, qpos, kpos, rope, sm_scale, causal, block_q,
-                block_k, interpret):
+                block_k, interpret, static_causal):
     return _fwd(q4, k4, v4, qpos, kpos, rope, sm_scale, causal, block_q,
-                block_k, interpret)
+                block_k, interpret, static_causal)
 
 
 def _flash_core_fwd(q4, k4, v4, qpos, kpos, rope, sm_scale, causal, block_q,
-                    block_k, interpret):
+                    block_k, interpret, static_causal):
     out, lse = _fwd(q4, k4, v4, qpos, kpos, rope, sm_scale, causal, block_q,
-                    block_k, interpret)
+                    block_k, interpret, static_causal)
     # Residuals carry the *named* values: under jax.checkpoint the "dots"
     # policy (models/llama.py remat_policy_for) saves attn_out/attn_lse, so
     # the backward pass reads them instead of re-running the forward kernel
@@ -583,12 +662,14 @@ def _flash_core_fwd(q4, k4, v4, qpos, kpos, rope, sm_scale, causal, block_q,
     return (out, lse), (q4, k4, v4, out_flat, lse, qpos, kpos, rope)
 
 
-def _flash_core_bwd(sm_scale, causal, block_q, block_k, interpret, res, cts):
+def _flash_core_bwd(sm_scale, causal, block_q, block_k, interpret,
+                    static_causal, res, cts):
     q4, k4, v4, out_flat, lse, qpos, kpos, rope = res
     do4, dlse = cts
     out = out_flat.reshape(do4.shape)
     dq, dk, dv = _bwd(q4, k4, v4, out, lse, do4, dlse, qpos, kpos, rope,
-                      sm_scale, causal, block_q, block_k, interpret)
+                      sm_scale, causal, block_q, block_k, interpret,
+                      static_causal)
     # rope tables get a zero cotangent (they are precomputed position
     # constants, never trained).
     drope = None if rope is None else jax.tree.map(jnp.zeros_like, rope)
@@ -646,6 +727,15 @@ def flash_attention(
             kv_positions=kv_positions, return_lse=return_lse,
             sm_scale=sm_scale)
     interpret = bool(interpret)
+    # Contiguous-causal fast path: positions passed as None mean plain
+    # 0..S-1, so block visibility is a static function of the program ids
+    # and the kernels elide every below-diagonal tile's DMAs (PERF.md r5:
+    # skipped programs measured ~1.4 us each — ~20% of the seq-16k
+    # forward kernel). Callers with genuinely permuted layouts (the CP
+    # ring/zigzag) pass explicit position arrays and keep the dynamic
+    # masking path.
+    static_causal = (causal and q_positions is None
+                     and kv_positions is None)
     qpos = (q_positions if q_positions is not None else jnp.arange(sq))
     kpos = (kv_positions if kv_positions is not None else jnp.arange(sk))
     qpos = qpos.astype(jnp.int32).reshape(1, sq)
@@ -661,7 +751,7 @@ def flash_attention(
     # bf16. Differentiable, so dq picks up the factor through the VJP chain.
     out, lse = _flash_core(q4 * jnp.asarray(sm_scale, q4.dtype), k4, v4,
                            qpos, kpos, rope, 1.0, causal, block_q,
-                           block_k, interpret)
+                           block_k, interpret, static_causal)
     out = jnp.swapaxes(out, 1, 2)
     if return_lse:
         # LSE is the *scaled-score* logsumexp, same convention as
@@ -719,6 +809,8 @@ def flash_attention_bwd_from_saved(
         _, vjp_fn = jax.vjp(f, q, k, v)
         return vjp_fn(dout)
     interpret = bool(interpret)
+    static_causal = (causal and q_positions is None
+                     and kv_positions is None)
     qpos = (q_positions if q_positions is not None else jnp.arange(sq))
     kpos = (kv_positions if kv_positions is not None else jnp.arange(sk))
     qpos = qpos.astype(jnp.int32).reshape(1, sq)
@@ -732,7 +824,7 @@ def flash_attention_bwd_from_saved(
     lse4 = lse[..., None]
     dq4, dk4, dv4 = _bwd(q4, k4, v4, o4, lse4, do4, jnp.zeros_like(lse4),
                          qpos, kpos, rope, 1.0, causal, block_q, block_k,
-                         interpret)
+                         interpret, static_causal)
     # chain rule through the q * sm_scale fold
     dq = jnp.swapaxes(dq4, 1, 2) * scale
     return dq, jnp.swapaxes(dk4, 1, 2), jnp.swapaxes(dv4, 1, 2)

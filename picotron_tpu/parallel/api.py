@@ -65,7 +65,12 @@ def make_parallel_ctx(cfg: Config) -> ParallelCtx:
     d = cfg.distributed
     s_local = cfg.training.seq_length // d.cp_size
     idx = lax.axis_index("cp")
-    if d.cp_size > 1 and d.cp_layout == "zigzag":
+    if d.cp_size == 1:
+        # contiguous 0..S-1 — encode as None (ParallelCtx's documented
+        # meaning) so the flash kernels take the static-causal fast path
+        # (program-id block classes + DMA-free skipped tiles; PERF.md r5)
+        positions = None
+    elif d.cp_layout == "zigzag":
         # Must mirror data.cp_sequence_permutation: shard r holds chunks
         # (r, 2cp-1-r) of 2cp chunks — its tokens' global positions.
         half = s_local // 2

@@ -152,3 +152,29 @@ def test_kernel_fused_rope_matches_unfused():
     for a, b, name in zip(gf, gr, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-4, err_msg=f"d{name}")
+
+
+def test_static_causal_matches_dynamic_positions():
+    """positions=None takes the static-causal fast path (program-id block
+    classes + DMA-free skipped tiles, PERF.md r5); an explicit arange must
+    produce bit-identical out AND grads through the dynamic-masking path."""
+    B, S, H, D = 2, 512, 4, 64
+    from picotron_tpu.ops.rope import rope_tables
+
+    q = jax.random.normal(jax.random.key(0), (B, S, H, D), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (B, S, H, D), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (B, S, H, D), jnp.float32)
+    rope = rope_tables(1024, D)
+
+    def loss(q, k, v, pos):
+        out = flash_attention(q, k, v, causal=True, rope=rope,
+                              q_positions=pos, kv_positions=pos,
+                              block_q=128, block_k=128, interpret=True)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    vs = jax.value_and_grad(loss, argnums=(0, 1, 2))
+    l_s, g_s = vs(q, k, v, None)
+    l_d, g_d = vs(q, k, v, jnp.arange(S))
+    assert float(l_s) == float(l_d)
+    for a, b in zip(g_s, g_d):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
