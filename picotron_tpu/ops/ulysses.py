@@ -26,6 +26,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -100,7 +101,8 @@ def ulysses_attention(
     # long-sequence path where that ~20% kernel overhead matters most,
     # code review r5). Decidable only for trace-time-known positions.
     pos_arg = pos_full
-    if full_positions is not None:
+    if full_positions is not None and not isinstance(full_positions,
+                                                     jax.core.Tracer):
         import numpy as np
 
         fp = np.asarray(full_positions)

@@ -178,3 +178,29 @@ def test_static_causal_matches_dynamic_positions():
     assert float(l_s) == float(l_d)
     for a, b in zip(g_s, g_d):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_static_causal_rectangular_and_mixed_blocks():
+    """sk > sq with bq != bk: exercises _q_eff's upper clamp (the last kv
+    blocks see no q block — an unclamped index would address past the q
+    array) and the bq != bk block-class integer math."""
+    from picotron_tpu.ops.rope import rope_tables
+
+    B, SQ, SK, H, D = 1, 256, 512, 2, 64
+    q = jax.random.normal(jax.random.key(0), (B, SQ, H, D), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (B, SK, H, D), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (B, SK, H, D), jnp.float32)
+    rope = rope_tables(1024, D)
+
+    def loss(q, k, v, qpos, kpos):
+        out = flash_attention(q, k, v, causal=True, rope=rope,
+                              q_positions=qpos, kv_positions=kpos,
+                              block_q=64, block_k=128, interpret=True)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    vs = jax.value_and_grad(loss, argnums=(0, 1, 2))
+    l_s, g_s = vs(q, k, v, None, None)
+    l_d, g_d = vs(q, k, v, jnp.arange(SQ), jnp.arange(SK))
+    assert float(l_s) == float(l_d)
+    for a, b in zip(g_s, g_d):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -25,7 +25,7 @@ from tests.test_optimizer_offload import batch_for, run_steps
 def engine_cfg(engine: str, model_kw=None, dist_kw=None, **tr) -> Config:
     tr.setdefault("seq_length", 64)
     tr.setdefault("micro_batch_size", 2)
-    tr.setdefault("gradient_accumulation_steps", 3)
+    tr.setdefault("gradient_accumulation_steps", 2)
     tr.setdefault("optimizer_offload", True)
     tr.setdefault("remat", True)
     tr.setdefault("remat_policy", "dots_attn")
@@ -41,7 +41,7 @@ def engine_cfg(engine: str, model_kw=None, dist_kw=None, **tr) -> Config:
     )
 
 
-def losses_and_master(cfg, steps=3):
+def losses_and_master(cfg, steps=2):
     losses, state, _ = run_steps(cfg, steps=steps)
     tree = (state.opt_state.master if cfg.training.optimizer_offload
             else state.params)

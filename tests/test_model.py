@@ -317,8 +317,10 @@ def test_qwen2_style_layouts_match_single_device():
         init_train_state, make_train_step as make_single_step,
     )
 
-    for dist in (dict(dp_size=2, tp_size=2),
-                 dict(pp_size=2, tp_size=2),
+    # pp2xtp2 exercises the gated last-stage scoring with the tied head;
+    # +sp swaps in the no-split CE path (dp2xtp2 pruned r5 — plain
+    # tp-sharded tying is a strict subset of both)
+    for dist in (dict(pp_size=2, tp_size=2),
                  dict(pp_size=2, tp_size=2, sequence_parallel=True)):
         cfg = Config(
             distributed=DistributedConfig(**dist),

@@ -372,13 +372,14 @@ def test_zero1_composition_shards_host_state():
 def test_zero1_composition_with_grad_clip():
     """The clip consumes the FULL grad tree before the zero1 slice — the
     scale must be identical on every shard (a sliced norm would diverge
-    per process and desynchronize the replicas)."""
+    per process and desynchronize the replicas). Slim shape (ga=1,
+    2 steps): the clip interaction is per-update, not per-microbatch."""
     base = offload_cfg(offload=True, grad_clip_norm=0.05,
-                       gradient_accumulation_steps=2)
+                       gradient_accumulation_steps=1)
     z1 = dataclasses.replace(
         base, distributed=dataclasses.replace(base.distributed, zero1=True))
-    l_base, _, _ = run_steps(base)
-    l_z1, _, _ = run_steps(z1)
+    l_base, _, _ = run_steps(base, steps=2)
+    l_z1, _, _ = run_steps(z1, steps=2)
     np.testing.assert_allclose(l_z1, l_base, rtol=1e-6)
 
 

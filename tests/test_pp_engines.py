@@ -51,7 +51,7 @@ def run_engine(cfg, steps=3):
 
 
 @pytest.mark.parametrize("layout", [
-    dict(pp=2, gas=4),
+    # (pp2/gas4 pruned r5: strict subset of pp4/gas4 and pp2xtp2)
     dict(pp=4, gas=4),
     dict(pp=2, gas=4, tp=2),
     dict(pp=2, gas=3, remat=True),  # odd n_micro + remat'd tick bodies

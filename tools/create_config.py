@@ -86,6 +86,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(the full-depth-on-one-chip lever; pair with "
                         "--grad-acc >= 16 to amortize the PCIe round "
                         "trip; requires bf16 model dtype)")
+    p.add_argument("--grad-engine", default="auto",
+                   choices=["auto", "ad", "fused"],
+                   help="'fused' accumulates per-layer dW in-scan (no "
+                        "per-microbatch grad tree; dense pp=cp=1 + "
+                        "remat_policy=dots_attn only); 'auto' picks it "
+                        "whenever supported")
     # dataset
     p.add_argument("--dataset", default="synthetic")
     p.add_argument("--subset", default=None)
@@ -159,6 +165,7 @@ def create_single_config(args) -> str:
             "optimizer_offload": args.optimizer_offload,
             "remat": not args.no_remat,
             "remat_policy": args.remat_policy,
+            "grad_engine": args.grad_engine,
         },
         "dataset": {
             "name": args.dataset, "subset_name": args.subset,
