@@ -321,3 +321,22 @@ def test_trace_summary_tool(tmp_path, capsys):
         trace_summary.load_events(str(tmp_path)))
     assert totals == {"fusion.1": 3000, "fusion.2": 1000}
     assert list(procs.values()) == ["/device:TPU:0"]
+
+
+def test_bench_decode_harness_smoke():
+    """bench.run_decode end-to-end at debug-tiny scale on the CPU backend:
+    the prefill/decode differencing, the JSON schema, and the
+    place_for_decode plumbing must not bitrot between hardware runs (the
+    real numbers come from `bench.py --decode` on the chip; PERF.md r5)."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    row = bench.run_decode("debug-tiny", 0, prompt_len=16, max_new=4,
+                           batch=2, steps=1)
+    assert row["unit"] == "decode_tokens_per_sec"
+    assert row["value"] > 0
+    assert row["prefill_tokens_per_sec"] > 0
+    assert row["batch"] == 2 and row["max_new_tokens"] == 4
