@@ -62,12 +62,14 @@ def test_parity_dense_dp():
     assert_engines_match()
 
 
+@pytest.mark.slow
 def test_parity_tp_vocab_parallel():
     # tp=2 exercises the ctx.f/g hook transposes and the vocab-parallel CE
     # inside the segment VJPs
     assert_engines_match(dk={"dp_size": 2, "tp_size": 2})
 
 
+@pytest.mark.slow
 def test_parity_qwen_bias_tied():
     # qkv bias leaves + tied embeddings (head grads flow into the
     # embedding leaf through head_weight's transpose)
@@ -75,10 +77,12 @@ def test_parity_qwen_bias_tied():
                                  tie_word_embeddings=True))
 
 
+@pytest.mark.slow
 def test_parity_sdpa_path():
     assert_engines_match(mk=dict(attn_impl="reference"))
 
 
+@pytest.mark.slow
 def test_parity_without_offload():
     # the engine is independent of where the optimizer state lives
     assert_engines_match(optimizer_offload=False)
@@ -102,6 +106,7 @@ def test_fused_rejects_unsupported_config():
         engine_cfg("fused", remat_policy="dots").validate()
 
 
+@pytest.mark.slow
 def test_grad_clip_parity():
     # the global-norm clip consumes the accumulated grads — same totals,
     # same clip scale, regardless of engine
