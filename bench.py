@@ -62,19 +62,19 @@ SWEEP = [
 ]
 
 
-def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
-            steps: int = 8, warmup: int = 2, remat: bool = True,
-            remat_policy: str = "dots", adam_moments_dtype: str = "bfloat16",
-            ce_chunk: int = 0, optimizer_offload: bool = False,
-            profile: str | None = None) -> dict:
+def bench_config(model: str, layers, seq: int, mbs: int, *,
+                 grad_acc: int = 1, remat: bool = True,
+                 remat_policy: str = "dots",
+                 adam_moments_dtype: str = "bfloat16", ce_chunk: int = 0,
+                 optimizer_offload: bool = False, n_chips: int = None):
+    """The exact Config a bench invocation trains — shared by the timed run
+    and `--shardcheck` so the static audit can never drift from what the
+    benchmark measures."""
     from picotron_tpu.config import (
         Config, DistributedConfig, ModelConfig, TrainingConfig, resolve_preset,
     )
-    from picotron_tpu.mesh import MeshEnv
-    from picotron_tpu.parallel.api import init_sharded_state, make_train_step
-    from picotron_tpu.utils import device_peak_flops, flops_per_token, mfu
 
-    n_chips = len(jax.devices())
+    n_chips = n_chips if n_chips is not None else len(jax.devices())
     preset = resolve_preset(model)
     preset["max_position_embeddings"] = max(
         preset.get("max_position_embeddings", seq), seq
@@ -96,6 +96,25 @@ def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
         ),
     )
     cfg.validate()
+    return cfg
+
+
+def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
+            steps: int = 8, warmup: int = 2, remat: bool = True,
+            remat_policy: str = "dots", adam_moments_dtype: str = "bfloat16",
+            ce_chunk: int = 0, optimizer_offload: bool = False,
+            profile: str | None = None) -> dict:
+    from picotron_tpu.mesh import MeshEnv
+    from picotron_tpu.parallel.api import init_sharded_state, make_train_step
+    from picotron_tpu.utils import device_peak_flops, flops_per_token, mfu
+
+    n_chips = len(jax.devices())
+    cfg = bench_config(model, layers, seq, mbs, grad_acc=grad_acc,
+                       remat=remat, remat_policy=remat_policy,
+                       adam_moments_dtype=adam_moments_dtype,
+                       ce_chunk=ce_chunk,
+                       optimizer_offload=optimizer_offload,
+                       n_chips=n_chips)
 
     menv = MeshEnv.from_config(cfg)
     state = init_sharded_state(cfg, menv, jax.random.key(0))
@@ -195,7 +214,23 @@ def run_decode(model: str, layers, prompt_len: int, max_new: int,
 
     t_prefill = timed(1)
     t_full = timed(max_new)
-    decode_tps = batch * (max_new - 1) / (t_full - t_prefill)
+    if t_full <= t_prefill:
+        # Timing jitter on a loaded host can make the differenced decode
+        # time <= 0 (tiny models, max_new close to 2). One re-measure
+        # absorbs a transient stall; a repeat means the measurement is
+        # genuinely degenerate and must fail loudly — an inf/negative
+        # decode tok/s must never be recorded (ADVICE r5).
+        t_prefill = timed(1)
+        t_full = timed(max_new)
+    if t_full <= t_prefill:
+        raise RuntimeError(
+            f"decode timing degenerate: full run ({t_full * 1e3:.3f} ms for "
+            f"{max_new} tokens) was not slower than the prefill-only run "
+            f"({t_prefill * 1e3:.3f} ms) — increase --max-new-tokens or "
+            f"re-run on an idle host; refusing to report a nonsensical "
+            f"decode rate")
+    dt = max(t_full - t_prefill, 1e-9)
+    decode_tps = batch * (max_new - 1) / dt
     return {
         "metric": f"decode_{model.split('/')[-1]}"
                   f"-{mcfg.num_hidden_layers}L",
@@ -205,8 +240,7 @@ def run_decode(model: str, layers, prompt_len: int, max_new: int,
         "batch": batch,
         "prompt_len": prompt_len,
         "max_new_tokens": max_new,
-        "decode_ms_per_token_per_seq": round(
-            (t_full - t_prefill) / (max_new - 1) * 1e3, 2),
+        "decode_ms_per_token_per_seq": round(dt / (max_new - 1) * 1e3, 2),
         "device_kind": jax.devices()[0].device_kind,
     }
 
@@ -260,6 +294,12 @@ def main() -> None:
     ap.add_argument("--sweep", action="store_true",
                     help="run the breadth matrix (one JSON line per config, "
                          "headline last) instead of a single config")
+    ap.add_argument("--shardcheck", action="store_true",
+                    help="statically audit the resolved config instead of "
+                         "timing it: spec lint, collective-schedule audit, "
+                         "donation/recompile hazards (picotron_tpu/"
+                         "analysis) — no step execution, works without a "
+                         "TPU; exit status reflects the findings")
     ap.add_argument("--decode", action="store_true",
                     help="measure generation instead of training: prefill "
                          "tokens/s + steady-state decode tokens/s on the "
@@ -271,6 +311,10 @@ def main() -> None:
     ap.add_argument("--max-new-tokens", type=int, default=128,
                     help="--decode: decode steps measured")
     args = ap.parse_args()
+
+    if args.shardcheck and (args.sweep or args.decode or args.profile):
+        ap.error("--shardcheck is its own mode; incompatible with "
+                 "--sweep/--decode/--profile")
 
     if args.decode:
         if args.sweep or args.profile:
@@ -358,10 +402,10 @@ def main() -> None:
                 one_attempt()
             vals = sorted(d["value"] for d in results)
             # tie-break a flaky row (VERDICT r4 #6): a >20% disagreement
-            # OR an errored attempt both leave the row resting on a single
-            # unconfirmed measurement — take a third attempt either way
-            if len(vals) == 1 or (len(vals) == 2
-                                  and vals[0] < 0.8 * vals[1]):
+            # OR fewer than two successful attempts (one errored — or BOTH
+            # errored, which the old `len == 1` test missed, ADVICE r5)
+            # leave the row unconfirmed — take a third attempt either way
+            if len(vals) < 2 or vals[0] < 0.8 * vals[1]:
                 one_attempt()
             if results:
                 best = max(results, key=lambda d: d["value"])
@@ -399,6 +443,30 @@ def main() -> None:
         args.mbs = args.mbs or 5
         args.grad_acc = args.grad_acc or 1
         args.remat_policy = args.remat_policy or "dots"
+    if args.shardcheck:
+        import sys
+
+        from picotron_tpu.analysis import run_shardcheck
+
+        cfg = bench_config(
+            args.model, args.layers, args.seq, args.mbs,
+            grad_acc=args.grad_acc, remat=not args.no_remat,
+            remat_policy=args.remat_policy,
+            adam_moments_dtype=args.adam_moments_dtype,
+            ce_chunk=args.ce_chunk,
+            optimizer_offload=args.optimizer_offload)
+        rep = run_shardcheck(cfg)
+        # human report on stderr; stdout keeps bench's one-JSON-line contract
+        print(rep.render(verbose=True), file=sys.stderr)
+        print(json.dumps({
+            "metric": f"shardcheck_{args.model.split('/')[-1]}"
+                      f"-{cfg.model.num_hidden_layers}L_seq{args.seq}",
+            "value": 1.0 if rep.ok() else 0.0,
+            "unit": "static_analysis_green",
+            "errors": len(rep.errors()),
+            "warnings": len(rep.warnings()),
+        }))
+        raise SystemExit(0 if rep.ok() else 1)
     print(json.dumps(run_one(
         args.model, args.layers, args.seq, args.mbs, grad_acc=args.grad_acc,
         steps=args.steps, warmup=args.warmup, remat=not args.no_remat,

@@ -1,0 +1,235 @@
+"""Collective-schedule audit — read the program's communication off its HLO.
+
+The composed step's collectives are implicit: `lax.psum(..., ('dp','ep',
+'cp'))` in the shard_map body, GSPMD-inserted reshardings, pipeline
+ppermutes. Whether the schedule is the *intended* one (exactly one grad
+all-reduce over the data axes; no all-gather quietly materializing a
+replicated tensor bigger than any weight) is checkable by parsing the
+lowered module text — no TPU time, no execution.
+
+Two rule families:
+
+- **presence**: the schedule a config promises must exist — a grad-sync
+  all-reduce whose replica-group size is dp*ep*cp (the fused data axes),
+  a pipeline boundary collective_permute when pp > 1, an expert-dispatch
+  all_to_all when ep > 1.
+- **budget** (the accidental-replication detector): no all-gather may
+  produce an output larger than the configured byte budget. The default
+  budget is the largest thing the program legitimately gathers — the
+  biggest single param leaf or one microbatch of full-sequence
+  activations, whichever is larger; an all-gather above that is some
+  tensor being silently un-sharded.
+
+Collectives over size-1 mesh axes lower to replica groups of size 1 and
+cost nothing; the audit counts only *effective* ops (group size > 1, or
+any cross-device permute pair).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+
+from picotron_tpu.analysis.report import ERROR, INFO, Report
+
+CHECK = "collectives"
+
+KINDS = ("all_reduce", "all_gather", "reduce_scatter", "collective_permute",
+         "all_to_all")
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
+                "i64": 8, "ui64": 8, "i32": 4, "ui32": 4, "i16": 2,
+                "ui16": 2, "i8": 1, "ui8": 1, "i1": 1}
+
+# stablehlo: replica_groups = dense<...> : tensor<GxSxi64>
+_RE_GROUPS = re.compile(
+    r"replica_groups = dense<[^>]*> : tensor<(\d+)x(\d+)xi64>")
+# stablehlo: source_target_pairs = dense<...> : tensor<Nx2xi64>
+_RE_PAIRS = re.compile(
+    r"source_target_pairs = dense<[^>]*> : tensor<(\d+)x2xi64>")
+# result types: "-> tensor<1x32x64xbf16>" (take the last on the line)
+_RE_RESULT = re.compile(r"-> tensor<([0-9x]*)x?([a-z]+[0-9]+|i1)>")
+# compiled-HLO dialect (optimized module text): replica_groups={{0,2},{1,3}}
+_RE_HLO_GROUPS = re.compile(r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
+# compiled-HLO iota form: replica_groups=[2,4]<=[8] -> 2 groups of 4
+_RE_HLO_IOTA = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
+_RE_HLO_PAIRS = re.compile(r"source_target_pairs=\{([^}]*)\}")
+_RE_HLO_SHAPE = re.compile(r"=\s*([a-z]+[0-9]+|pred)\[([0-9,]*)\]")
+
+
+@dataclass(frozen=True)
+class CollectiveOp:
+    kind: str                       # one of KINDS
+    group_size: Optional[int]       # participants per replica group
+    n_groups: Optional[int]
+    nbytes: Optional[int]           # result size, when parseable
+    shape: Optional[tuple]
+    dtype: Optional[str]
+    line: int                       # 1-based line in the module text
+
+    @property
+    def effective(self) -> bool:
+        """Moves bytes between devices (vs a compiled-away size-1 group)."""
+        if self.kind == "collective_permute":
+            return (self.n_groups or 0) > 0
+        return (self.group_size or 0) > 1
+
+
+def _result_bytes(line: str):
+    m = None
+    for m in _RE_RESULT.finditer(line):
+        pass
+    if m is None:
+        return None, None, None
+    dims_txt, dtype = m.group(1), m.group(2)
+    dims = tuple(int(d) for d in dims_txt.split("x") if d) if dims_txt \
+        else ()
+    nbytes = math.prod(dims) * _DTYPE_BYTES.get(dtype, 4) if dims else \
+        _DTYPE_BYTES.get(dtype, 4)
+    return nbytes, dims, dtype
+
+
+def parse_collectives(text: str) -> list[CollectiveOp]:
+    """Collective ops from module text — StableHLO (`stablehlo.all_reduce`)
+    or compiled HLO (`all-reduce(`); both dialects normalize to KINDS."""
+    ops: list[CollectiveOp] = []
+    lines = text.splitlines()
+    for i, line in enumerate(lines):
+        kind = None
+        for k in KINDS:
+            if (f"stablehlo.{k}" in line
+                    or re.search(rf"=\s+{k.replace('_', '-')}", line)):
+                kind = k
+                break
+        if kind is None:
+            continue
+        group_size = n_groups = None
+        if kind == "collective_permute":
+            m = _RE_PAIRS.search(line)
+            if m:
+                n_groups = int(m.group(1))
+            else:
+                m = _RE_HLO_PAIRS.search(line)
+                if m:
+                    n_groups = len([p for p in m.group(1).split("{") if p])
+        else:
+            m = _RE_GROUPS.search(line)
+            if m:
+                n_groups, group_size = int(m.group(1)), int(m.group(2))
+            else:
+                m = _RE_HLO_IOTA.search(line)
+                if m:
+                    n_groups, group_size = int(m.group(1)), int(m.group(2))
+                else:
+                    m = _RE_HLO_GROUPS.search(line)
+                    if m:
+                        groups = m.group(1).split("},{")
+                        n_groups = len(groups)
+                        group_size = len(groups[0].strip("{}").split(","))
+        # result type: same line for region-free ops, else the region's
+        # closing `}) : (...) -> type` a few lines down
+        nbytes = dims = dtype = None
+        if "stablehlo" in line:
+            if "-> tensor<" in line:
+                nbytes, dims, dtype = _result_bytes(line)
+            else:
+                for j in range(i + 1, min(i + 64, len(lines))):
+                    if lines[j].lstrip().startswith("})"):
+                        nbytes, dims, dtype = _result_bytes(lines[j])
+                        break
+        else:
+            m = _RE_HLO_SHAPE.search(line)
+            if m:
+                dtype = m.group(1)
+                dims = tuple(int(d) for d in m.group(2).split(",") if d)
+                nbytes = math.prod(dims) * _DTYPE_BYTES.get(dtype, 4)
+        ops.append(CollectiveOp(kind, group_size, n_groups, nbytes, dims,
+                                dtype, i + 1))
+    return ops
+
+
+def default_gather_budget(cfg, state) -> int:
+    """Largest tensor the program legitimately all-gathers in one op: the
+    biggest param leaf, or one microbatch of full-sequence activations
+    (sequence-parallel / ulysses gathers restore [mbs, S, H])."""
+    from picotron_tpu.models.llama import compute_dtype
+
+    import jax.numpy as jnp
+
+    param_max = max(
+        (math.prod(p.shape) * jnp.dtype(p.dtype).itemsize
+         for p in jax.tree_util.tree_leaves(state.params)), default=0)
+    act = (cfg.training.micro_batch_size * cfg.training.seq_length
+           * cfg.model.hidden_size
+           * jnp.dtype(compute_dtype(cfg.model)).itemsize)
+    return max(param_max, act, 1)
+
+
+def audit_collectives(cfg, *, text: str = None, state=None,
+                      budget_bytes: int = None, menv=None) -> Report:
+    """Audit a config's collective schedule. Pass `text` (+ `state`) to
+    audit an existing lowering; otherwise the train step is lowered here."""
+    if text is None:
+        from picotron_tpu.analysis.trace import lower_train_step
+
+        low = lower_train_step(cfg, menv)
+        text, state = low.text, low.state
+    ops = parse_collectives(text)
+    eff = [op for op in ops if op.effective]
+    d = cfg.distributed
+    rep = Report()
+
+    counts = {k: sum(1 for op in eff if op.kind == k) for k in KINDS}
+    rep.info[CHECK] = {
+        **counts,
+        "total_effective": len(eff),
+        "compiled_away (size-1 groups)": len(ops) - len(eff),
+    }
+
+    # -- presence rules ----------------------------------------------------
+    grad_group = d.dp_size * d.ep_size * d.cp_size
+    if grad_group > 1:
+        grad_ars = [op for op in eff if op.kind == "all_reduce"
+                    and op.group_size == grad_group]
+        if not grad_ars:
+            rep.add(CHECK, ERROR, "all_reduce",
+                    f"no all-reduce over the fused data axes found "
+                    f"(expected replica groups of size dp*ep*cp = "
+                    f"{grad_group}): gradients are NOT being synchronized "
+                    f"across data-parallel shards")
+        else:
+            rep.add(CHECK, INFO, "all_reduce",
+                    f"{len(grad_ars)} all-reduce op(s) over the fused data "
+                    f"axes (group size {grad_group}) — gradient/loss sync")
+    if d.pp_size > 1 and not any(op.kind == "collective_permute"
+                                 for op in eff):
+        rep.add(CHECK, ERROR, "collective_permute",
+                f"pp_size={d.pp_size} but the lowered step contains no "
+                f"collective_permute: the pipeline boundary exchange is "
+                f"missing")
+    if (d.ep_size > 1 and cfg.model.num_experts
+            and not any(op.kind == "all_to_all" for op in eff)):
+        rep.add(CHECK, ERROR, "all_to_all",
+                f"ep_size={d.ep_size} with {cfg.model.num_experts} experts "
+                f"but no all_to_all: expert dispatch is not crossing the "
+                f"'ep' axis (tokens only ever reach local experts)")
+
+    # -- budget rule: the accidental-replication detector ------------------
+    if budget_bytes is None and state is not None:
+        budget_bytes = default_gather_budget(cfg, state)
+    if budget_bytes is not None:
+        for op in eff:
+            if op.kind != "all_gather" or op.nbytes is None:
+                continue
+            if op.nbytes > budget_bytes:
+                rep.add(CHECK, ERROR, f"all_gather@L{op.line}",
+                        f"all-gather output {op.dtype}{list(op.shape)} is "
+                        f"{op.nbytes} bytes, over the replication budget "
+                        f"of {budget_bytes} bytes — something sharded is "
+                        f"being materialized fully replicated")
+        rep.info[CHECK]["gather_budget_bytes"] = budget_bytes
+    return rep

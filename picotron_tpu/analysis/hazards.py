@@ -1,0 +1,204 @@
+"""Donation and recompilation hazards — trace-time, no execution.
+
+Two failure modes this catches have each cost real TPU hours:
+
+- **Undonated state.** The train step is written `jit(donate_argnums=(0,))`
+  so every TrainState buffer is updated in place; losing donation on any
+  leaf (a refactor that reorders arguments, a leaf the aliaser cannot
+  match) silently doubles that leaf's residency — at SmolLM-1.7B scale the
+  difference between fitting and OOM. The lowered module records donation
+  per argument (`jax.buffer_donor` / `tf.aliasing_output` attributes);
+  this check walks the argument list against the flattened (state, batch)
+  pytree and names every state leaf that lost it.
+
+- **Unstable step signature.** jit retraces whenever an input aval changes.
+  If the step's *output* state differs from its input state in any aval
+  (a weak-typed scalar from a Python-float closure, an int32 counter
+  promoted to int64, a dtype change on one leaf), step 2 sees new input
+  avals and recompiles — the classic silent 2x compile. `jax.eval_shape`
+  over the jitted step makes this a pure host check: output avals must be
+  identical to input avals, leaf for leaf.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+
+from picotron_tpu.analysis.report import ERROR, WARNING, Report
+from picotron_tpu.analysis.spec_lint import dict_by_path
+
+DONATION = "donation"
+STABILITY = "recompile"
+
+_DONOR_MARKS = ("jax.buffer_donor", "tf.aliasing_output")
+
+
+def _main_signature(text: str) -> str:
+    """The argument list of the module's public main func — characters
+    between '@main(' and its matching ')', quote-aware (sharding strings
+    contain parentheses)."""
+    start = text.find("@main(")
+    if start < 0:
+        return ""
+    i = start + len("@main(")
+    depth, in_str = 1, False
+    out = []
+    while i < len(text) and depth:
+        c = text[i]
+        if in_str:
+            if c == '"':
+                in_str = False
+        elif c == '"':
+            in_str = True
+        elif c in "([{<":
+            depth += 1
+        elif c in ")]}>":
+            depth -= 1
+            if depth == 0:
+                break
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def parse_arg_donation(text: str) -> list[bool]:
+    """Per-argument donation flags of the lowered module's main func, in
+    argument order (== the flattened input pytree order)."""
+    sig = _main_signature(text)
+    flags: dict[int, bool] = {}
+    # each arg: %argN: tensor<...> {attrs} — attrs optional
+    for m in re.finditer(r"%arg(\d+):", sig):
+        idx = int(m.group(1))
+        nxt = sig.find("%arg", m.end())
+        seg = sig[m.end(): nxt if nxt >= 0 else len(sig)]
+        flags[idx] = any(mark in seg for mark in _DONOR_MARKS)
+    return [flags[i] for i in sorted(flags)]
+
+
+def check_donation(lowered, state=None, batch=None) -> Report:
+    """Every TrainState leaf must be donated; batch leaves must not be.
+
+    Pass the `jax.stages.Lowered` when available — its `args_info` pytree
+    carries per-input donation with the original structure, immune to the
+    lowering pruning unused arguments. A raw StableHLO string falls back to
+    parsing the module's argument attributes (then `state`/`batch` supply
+    the leaf paths, in flattened argument order).
+    """
+    if hasattr(lowered, "args_info"):
+        return _check_donation_args_info(lowered.args_info)
+    return _check_donation_text(lowered, state, batch)
+
+
+def _check_donation_args_info(args_info) -> Report:
+    rep = Report()
+    # args_info mirrors the traced call: ((state, batch), kwargs)
+    (state_info, batch_info), _kwargs = args_info
+    state_leaves = dict_by_path(state_info)
+    n_state = len(state_leaves)
+    for path, info in state_leaves.items():
+        if not info.donated:
+            rep.add(DONATION, ERROR, path,
+                    f"TrainState buffer ({info.dtype}{list(info.shape)}) "
+                    f"is not donated: the step holds input AND output "
+                    f"copies alive simultaneously — re-check "
+                    f"donate_argnums and that this leaf round-trips the "
+                    f"step with identical shape/dtype")
+    for path, info in dict_by_path(batch_info).items():
+        if info.donated:
+            rep.add(DONATION, WARNING, path,
+                    "batch input is donated — batches are rebuilt each "
+                    "step so this is harmless, but it suggests "
+                    "donate_argnums drifted")
+    rep.info[DONATION] = {
+        "state_leaves": n_state,
+        "donated": sum(1 for i in state_leaves.values() if i.donated),
+    }
+    return rep
+
+
+def _check_donation_text(text: str, state, batch) -> Report:
+    rep = Report()
+    flags = parse_arg_donation(text)
+    state_leaves = dict_by_path(state)
+    batch_leaves = dict_by_path(batch)
+    n_state, n_batch = len(state_leaves), len(batch_leaves)
+    if len(flags) != n_state + n_batch:
+        # argument list does not line up with the input pytree (constants
+        # promoted to args, or a future lowering change) — report coverage
+        # coarsely rather than mis-attribute leaves
+        donated = sum(flags)
+        rep.add(DONATION, WARNING, "<main>",
+                f"argument count {len(flags)} != state+batch leaves "
+                f"{n_state + n_batch}; coarse check only ({donated} of "
+                f"{len(flags)} args donated)")
+        if donated < n_state:
+            rep.add(DONATION, ERROR, "<main>",
+                    f"only {donated} donated arguments for {n_state} state "
+                    f"leaves — at least one TrainState buffer is undonated "
+                    f"(its memory is held twice across the step)")
+        return rep
+    paths = list(state_leaves) + list(batch_leaves)
+    undonated = [p for p, f in zip(paths[:n_state], flags[:n_state])
+                 if not f]
+    for p in undonated:
+        leaf = state_leaves[p]
+        rep.add(DONATION, ERROR, p,
+                f"TrainState buffer ({leaf.dtype}{list(leaf.shape)}) is "
+                f"not donated: the step holds input AND output copies "
+                f"alive simultaneously — re-check donate_argnums and that "
+                f"this leaf round-trips the step with identical "
+                f"shape/dtype")
+    donated_batch = [p for p, f in
+                     zip(paths[n_state:], flags[n_state:]) if f]
+    for p in donated_batch:
+        rep.add(DONATION, WARNING, p,
+                "batch input is donated — batches are rebuilt each step so "
+                "this is harmless, but it suggests donate_argnums drifted")
+    rep.info[DONATION] = {
+        "state_leaves": n_state,
+        "donated": sum(flags[:n_state]),
+    }
+    return rep
+
+
+def check_state_stability(step_fn, state, batch) -> Report:
+    """The step's output state avals must equal its input state avals —
+    anything else forces a retrace + recompile on the next call."""
+    rep = Report()
+    out = jax.eval_shape(step_fn, state, batch)
+    new_state = out[0] if isinstance(out, tuple) else out
+    in_struct = jax.tree_util.tree_structure(state)
+    out_struct = jax.tree_util.tree_structure(new_state)
+    if in_struct != out_struct:
+        rep.add(STABILITY, ERROR, "<state>",
+                f"output state pytree structure differs from input "
+                f"({out_struct} vs {in_struct}): every step retraces")
+        return rep
+    ins = dict_by_path(state)
+    outs = dict_by_path(new_state)
+    for path, a in ins.items():
+        b = outs[path]
+        if tuple(a.shape) != tuple(b.shape) or a.dtype != b.dtype:
+            rep.add(STABILITY, ERROR, path,
+                    f"state leaf changes aval across the step: "
+                    f"{a.dtype}{list(a.shape)} in, {b.dtype}{list(b.shape)}"
+                    f" out — step 2 recompiles the whole program")
+        elif getattr(a, "weak_type", False) != getattr(b, "weak_type",
+                                                       False):
+            rep.add(STABILITY, ERROR, path,
+                    "state leaf flips weak_type across the step (a Python "
+                    "scalar leaked into the update math): step 2 "
+                    "recompiles; wrap the scalar in jnp.asarray with an "
+                    "explicit dtype")
+    # metrics: weak types here do not recompile (metrics are outputs only)
+    # but reveal Python-scalar closures worth pinning
+    if isinstance(out, tuple) and len(out) > 1:
+        for path, leaf in dict_by_path(out[1]).items():
+            if getattr(leaf, "weak_type", False):
+                rep.add(STABILITY, WARNING, f"metrics/{path}",
+                        "weak-typed metric (Python scalar reached the "
+                        "traced output); benign, but pin its dtype")
+    rep.info[STABILITY] = {"state_leaves": len(ins)}
+    return rep

@@ -1,0 +1,157 @@
+"""AST source lint — repo-wide rules the runtime analyzers cannot see.
+
+Trace-time analysis audits one config's program; some hazards live in the
+source itself, on paths a given trace never visits. Rules:
+
+- **jax-core** (error): any use of the semi-private `jax.core` namespace
+  (`import jax.core`, `from jax.core import Tracer`, `jax.core.Tracer`
+  attribute chains). Its re-exports get shuffled between JAX releases, so
+  these break on upgrade at runtime, usually deep inside a jit. The
+  sanctioned alternatives: `jax.errors` for tracer-leak detection, or a
+  static flag plumbed from the caller when tracedness is knowable at trace
+  time (how ops/ulysses.py gets it from make_parallel_ctx).
+- **jax-private** (warning): `jax._src` imports. Sometimes unavoidable
+  (mesh.py consults distributed global state pre-init); always worth an
+  eyebrow, never a hard failure.
+- **host-callback** (error): `jax.pure_callback` / `io_callback` /
+  `jax.debug.callback` in library code. Everything under picotron_tpu/ can
+  end up inside the jitted step, where a host callback serializes the
+  device stream per call — the kind of 10x step-time surprise only a real
+  TPU run would otherwise reveal.
+
+Suppress a finding with a `# shardcheck: ok` comment on the line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from picotron_tpu.analysis.report import ERROR, WARNING, Report
+
+CHECK = "source_lint"
+
+_HOST_CALLBACKS = {"pure_callback", "io_callback", "callback",
+                   "host_callback"}
+
+
+def _attr_chain(node) -> list[str]:
+    """['jax', 'core', 'Tracer'] for jax.core.Tracer; [] if not a chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, relpath: str, suppressed: set, rep: Report):
+        self.relpath = relpath
+        self.suppressed = suppressed
+        self.rep = rep
+
+    def _add(self, node, severity, message):
+        if node.lineno in self.suppressed:
+            return
+        self.rep.add(CHECK, severity, f"{self.relpath}:{node.lineno}",
+                     message)
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            if alias.name == "jax.core" or \
+                    alias.name.startswith("jax.core."):
+                self._add(node, ERROR,
+                          f"import of semi-private {alias.name!r} — use "
+                          f"jax.errors / a static flag instead")
+            elif alias.name.startswith("jax._src"):
+                self._add(node, WARNING,
+                          f"private-namespace import {alias.name!r}")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        mod = node.module or ""
+        if mod == "jax.core" or mod.startswith("jax.core."):
+            self._add(node, ERROR,
+                      f"import from semi-private 'jax.core' "
+                      f"({', '.join(a.name for a in node.names)}) — use "
+                      f"jax.errors / a static flag instead")
+        elif mod.startswith("jax._src"):
+            self._add(node, WARNING,
+                      f"private-namespace import from {mod!r}")
+        elif mod == "jax":
+            for alias in node.names:
+                if alias.name == "core":
+                    self._add(node, ERROR,
+                              "from jax import core — semi-private "
+                              "namespace")
+                elif alias.name in _HOST_CALLBACKS:
+                    self._add(node, ERROR,
+                              f"host callback {alias.name!r} imported "
+                              f"into library code: inside a jitted path "
+                              f"it serializes the device stream per call")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        chain = _attr_chain(node)
+        if len(chain) >= 2 and chain[0] == "jax":
+            if chain[1] == "core":
+                self._add(node, ERROR,
+                          f"use of semi-private jax.core "
+                          f"({'.'.join(chain)}) — its re-exports move "
+                          f"between JAX releases")
+            elif chain[1] in ("pure_callback",) or (
+                    len(chain) >= 3
+                    and chain[1] in ("debug", "experimental")
+                    and chain[2] in _HOST_CALLBACKS):
+                self._add(node, ERROR,
+                          f"host callback {'.'.join(chain)} in library "
+                          f"code: inside a jitted path it serializes the "
+                          f"device stream per call")
+        self.generic_visit(node)
+
+
+def _suppressed_lines(src: str) -> set:
+    return {i + 1 for i, line in enumerate(src.splitlines())
+            if "# shardcheck: ok" in line}
+
+
+def lint_file(path: str, relpath: str = None) -> Report:
+    rep = Report()
+    relpath = relpath or path
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        rep.add(CHECK, ERROR, f"{relpath}:{e.lineno}",
+                f"syntax error: {e.msg}")
+        return rep
+    _Visitor(relpath, _suppressed_lines(src), rep).visit(tree)
+    return rep
+
+
+def lint_sources(roots=None) -> Report:
+    """Lint every .py file under `roots` (default: the picotron_tpu
+    package — the code that can land inside the jitted step)."""
+    if roots is None:
+        roots = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+    rep = Report()
+    n_files = 0
+    for root in roots:
+        if os.path.isfile(root):
+            files = [root]
+            base = os.path.dirname(root)
+        else:
+            base = os.path.dirname(root.rstrip(os.sep))
+            files = sorted(
+                os.path.join(dp, f)
+                for dp, _, fs in os.walk(root) for f in fs
+                if f.endswith(".py"))
+        for path in files:
+            n_files += 1
+            rep.extend(lint_file(path, os.path.relpath(path, base)))
+    rep.info[CHECK] = {"files": n_files}
+    return rep

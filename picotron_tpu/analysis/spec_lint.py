@@ -1,0 +1,125 @@
+"""Spec lint — PartitionSpec pytree vs. param pytree vs. mesh, statically.
+
+The GSPMD contract this repo trains under is one declarative pytree of
+PartitionSpecs (parallel/sharding.py) that must stay leaf-for-leaf aligned
+with the model's param pytree (models/llama.py init_params) and
+axis-for-axis consistent with the mesh (mesh.py AXES). Nothing enforces
+that alignment at authoring time: a renamed param, a spec with one entry
+too many, or a sharded dim the mesh axis does not divide all surface as
+XLA partitioner errors (or, worse, silent replication) deep inside the
+first jit. This lint walks the three structures against each other on the
+host — no devices, no tracing — and reports every mismatch with the exact
+pytree path.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Mapping
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from picotron_tpu.analysis.report import ERROR, Report
+from picotron_tpu.mesh import AXES
+
+CHECK = "spec_lint"
+
+
+def _path_str(path) -> str:
+    """'layers/q'-style rendering of a jax key path."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):       # DictKey
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):     # SequenceKey
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):    # GetAttrKey / FlattenedIndexKey
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts) or "<root>"
+
+
+def _spec_axes(entry) -> tuple:
+    """Mesh axes named by one PartitionSpec entry (None -> ())."""
+    if entry is None:
+        return ()
+    if isinstance(entry, (tuple, list)):
+        return tuple(entry)
+    return (entry,)
+
+
+def lint_specs(specs: Any, params: Any,
+               axis_sizes: Mapping[str, int]) -> Report:
+    """Core lint over arbitrary (specs, params) pytrees.
+
+    specs: pytree whose leaves are PartitionSpec; params: matching pytree of
+    arrays / ShapeDtypeStructs; axis_sizes: mesh axis name -> size. Pure
+    host computation so mutation tests can feed deliberately broken trees.
+    """
+    rep = Report()
+    spec_leaves = dict_by_path(specs, is_leaf=lambda x: isinstance(x, P))
+    param_leaves = dict_by_path(params)
+
+    for path in sorted(set(spec_leaves) - set(param_leaves)):
+        rep.add(CHECK, ERROR, path,
+                "spec leaf has no matching param leaf (stale or misspelled "
+                "entry in param_specs)")
+    for path in sorted(set(param_leaves) - set(spec_leaves)):
+        rep.add(CHECK, ERROR, path,
+                "param leaf has no PartitionSpec (param_specs is missing "
+                "this leaf; it would be fully replicated by accident)")
+
+    for path in sorted(set(spec_leaves) & set(param_leaves)):
+        spec, leaf = spec_leaves[path], param_leaves[path]
+        shape = tuple(leaf.shape)
+        if len(spec) > len(shape):
+            rep.add(CHECK, ERROR, path,
+                    f"spec {spec} has {len(spec)} entries but the param "
+                    f"has rank {len(shape)} (shape {shape})")
+            continue
+        seen: dict[str, int] = {}
+        for dim, entry in enumerate(spec):
+            axes = _spec_axes(entry)
+            for a in axes:
+                if a not in axis_sizes:
+                    rep.add(CHECK, ERROR, path,
+                            f"dim {dim}: unknown mesh axis {a!r} (mesh "
+                            f"axes: {tuple(axis_sizes)})")
+                elif a in seen:
+                    rep.add(CHECK, ERROR, path,
+                            f"dim {dim}: mesh axis {a!r} already shards "
+                            f"dim {seen[a]} — an axis may shard at most "
+                            f"one dimension")
+                else:
+                    seen[a] = dim
+            factor = math.prod(axis_sizes.get(a, 1) for a in axes)
+            if factor > 1 and shape[dim] % factor != 0:
+                rep.add(CHECK, ERROR, path,
+                        f"dim {dim} (size {shape[dim]}) is not divisible "
+                        f"by mesh axes {axes} (product {factor}) — each "
+                        f"device would need a ragged shard")
+    rep.info[CHECK] = {
+        "spec_leaves": len(spec_leaves),
+        "param_leaves": len(param_leaves),
+        "mesh_axes": dict(axis_sizes),
+    }
+    return rep
+
+
+def dict_by_path(tree: Any, is_leaf=None) -> dict[str, Any]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree, is_leaf=is_leaf)
+    return {_path_str(path): leaf for path, leaf in flat}
+
+
+def lint_param_specs(cfg) -> Report:
+    """Config-level lint: parallel/sharding.py's specs against the model's
+    actual (pp-padded) param tree and the config's mesh axis sizes."""
+    from picotron_tpu.parallel.api import abstract_master
+    from picotron_tpu.parallel.sharding import param_specs
+
+    d = cfg.distributed
+    axis_sizes = dict(zip(AXES, (d.dp_size, d.pp_size, d.ep_size,
+                                 d.cp_size, d.tp_size)))
+    return lint_specs(param_specs(cfg), abstract_master(cfg), axis_sizes)

@@ -44,6 +44,10 @@ from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# renamed TPUCompilerParams -> CompilerParams across pallas releases
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 # Swept on v5e at seq 2048 (B3 H32 D64): 1024x1024 runs 4x faster than
 # 256x256 — the kernel is VPU/overhead-bound, not MXU-bound, so fewer,
 # larger programs win. VMEM (fp32 [BQ, BK] score block) caps growth: 2048^2
@@ -117,9 +121,13 @@ def _out_struct(shape, dtype, *operands):
     """ShapeDtypeStruct whose `vma` is the union of the operands' varying
     mesh axes — required for pallas_call under shard_map(check_vma=True)
     (the CP ring runs this kernel on 'cp'-varying blocks)."""
+    from picotron_tpu import compat
+
     vma = frozenset()
     for x in operands:
-        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+        vma = vma | compat.vma(x)
+    if not compat.HAS_VMA:  # pre-vma ShapeDtypeStruct has no vma kwarg
+        return jax.ShapeDtypeStruct(shape, dtype)
     return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
 
 
@@ -303,7 +311,7 @@ def _fwd(q4, k4, v4, qpos, kpos, rope, sm_scale, causal, block_q, block_k,
             pltpu.VMEM((bq, d), jnp.float32),     # acc
         ] + ([pltpu.VMEM((bq, d), q4.dtype)]      # rotated q, reused per ki
              if rope is not None else []),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -562,7 +570,7 @@ def _bwd(q4, k4, v4, o4, lse, do4, dlse, qpos, kpos, rope, sm_scale, causal,
                               *rope_args),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)]
         + ([pltpu.VMEM((bq, d), q4.dtype)] if rope is not None else []),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -623,7 +631,7 @@ def _bwd(q4, k4, v4, o4, lse, do4, dlse, qpos, kpos, rope, sm_scale, causal,
             pltpu.VMEM((bk, d), jnp.float32),
         ] + ([pltpu.VMEM((bk, d), k4.dtype)]  # rotated k, reused per t
              if rope is not None else []),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,

@@ -100,14 +100,18 @@ def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
         # out-of-range gathers would silently clamp). The max itself can
         # come back traced even for a concrete `positions` when this runs
         # under an outer trace (a scan body closing over constant
-        # positions), so the guard checks the RESULT, not the input.
-        if not isinstance(positions, jax.core.Tracer):
-            pmax = jnp.max(positions)
-            if not isinstance(pmax, jax.core.Tracer) \
-                    and int(pmax) >= cos.shape[0]:
-                raise ValueError(
-                    f"position {int(pmax)} exceeds the RoPE table length "
-                    f"{cos.shape[0]}")
+        # positions), so concreteness is probed by attempting the int()
+        # conversion — the public spelling (jax.errors) of the old
+        # `isinstance(..., jax.core.Tracer)` checks, whose semi-private
+        # namespace the shardcheck source lint forbids (ADVICE r5).
+        try:
+            pmax = int(jnp.max(positions))
+        except jax.errors.ConcretizationTypeError:
+            pmax = None  # traced: checkable only at runtime
+        if pmax is not None and pmax >= cos.shape[0]:
+            raise ValueError(
+                f"position {pmax} exceeds the RoPE table length "
+                f"{cos.shape[0]}")
         c = cos[positions]
         s = sin[positions]
     c = c[None, :, None, :]  # [1, S, 1, D/2]

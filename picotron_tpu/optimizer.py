@@ -172,7 +172,8 @@ def offload_adam_update(grads, state: OffloadAdamState, t: TrainingConfig,
     `add_decayed_weights` + `scale_by_learning_rate` chain (and to
     optax.adamw for fp32 moments): offload changes WHERE state lives, not
     what the update computes."""
-    from jax._src.core import MemorySpace  # accepted by public device_put
+    if transfer:
+        from picotron_tpu.compat import memory_space_puts
 
     b1, b2, eps = t.adam_beta1, t.adam_beta2, t.adam_eps
     wd = t.weight_decay
@@ -197,10 +198,10 @@ def offload_adam_update(grads, state: OffloadAdamState, t: TrainingConfig,
         scale = scale * jnp.where(gn < t.grad_clip_norm, 1.0,
                                   t.grad_clip_norm / gn)
 
-    to_dev = (lambda x: jax.device_put(x, MemorySpace.Device)) if transfer \
-        else (lambda x: x)
-    to_host = (lambda x: jax.device_put(x, MemorySpace.Host)) if transfer \
-        else (lambda x: x)
+    if transfer:
+        to_dev, to_host = memory_space_puts()
+    else:
+        to_dev = to_host = lambda x: x
 
     def math(p, m, n, g):
         g = g.astype(jnp.float32) * scale
@@ -356,10 +357,12 @@ def offload_adam_update(grads, state: OffloadAdamState, t: TrainingConfig,
     tokens: dict = {}
 
     def token_for(leaf):
-        key = frozenset(getattr(jax.typeof(leaf), "vma", frozenset()))
+        from picotron_tpu import compat
+
+        key = compat.vma(leaf)
         if key not in tokens:
             tok = jnp.zeros((), jnp.float32)
-            if key:
+            if key:  # only ever non-empty when the vma types exist
                 tok = lax.pvary(tok, tuple(sorted(key)))
             tokens[key] = tok
         return key, tokens[key]

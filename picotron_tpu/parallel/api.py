@@ -34,6 +34,7 @@ import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from picotron_tpu import compat
 from picotron_tpu.config import Config
 from picotron_tpu.mesh import MeshEnv
 from picotron_tpu.models.llama import (
@@ -120,7 +121,10 @@ def make_parallel_ctx(cfg: Config) -> ParallelCtx:
             return ulysses_attention(q, k, v, axis="cp", q_positions=pos,
                                      attn_fn=attn_fn, rope=rope,
                                      seq_sort=seq_sort,
-                                     full_positions=full_pos)
+                                     full_positions=full_pos,
+                                     # full_pos is built from the config
+                                     # right here — a trace-time constant
+                                     positions_static=True)
     elif d.cp_size > 1:
         from picotron_tpu.ops.ring_attention import ring_attention
         from picotron_tpu.ops.rope import apply_rope
@@ -351,9 +355,9 @@ def _device_grads(params, batch, cfg: Config):
         # bf16 + fp32 -> fp32).
         zeros = jax.tree.map(
             lambda p: _vary_over(jnp.zeros(p.shape, jnp.float32),
-                                 {"dp", "ep", "cp"} | set(jax.typeof(p).vma)),
+                                 {"dp", "ep", "cp"} | set(compat.vma(p))),
             params)
-        init_carry = (zeros,) + lax.pcast(
+        init_carry = (zeros,) + compat.pcast(
             (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32),
              jnp.zeros((), jnp.float32)),
             ("dp", "ep", "cp"), to="varying")
@@ -397,7 +401,7 @@ def make_train_step(cfg: Config, menv: MeshEnv):
     pspecs = param_specs(cfg)
     bspec = batch_spec()
 
-    grad_fn = jax.shard_map(
+    grad_fn = compat.shard_map(
         partial(_device_grads, cfg=cfg),
         mesh=mesh,
         in_specs=(pspecs, (bspec, bspec)),
@@ -447,7 +451,7 @@ def make_train_step(cfg: Config, menv: MeshEnv):
         # sharded over the zero1 axes (out spec = mspecs); the GSPMD
         # constraint below re-gathers them to the full param layout — the
         # ZeRO-1 update all-gather, expressed as a resharding.
-        fused = jax.shard_map(
+        fused = compat.shard_map(
             _device_step, mesh=mesh,
             in_specs=(pspecs, (bspec, bspec), opt_specs),
             out_specs=(mspecs, opt_specs, P(), P()))
@@ -502,7 +506,7 @@ def make_eval_step(cfg: Config, menv: MeshEnv):
                                                  cfg.model, ctx)
                 return (l_acc + total, c_acc + count), None
 
-            init = lax.pcast(
+            init = compat.pcast(
                 (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
                 ("dp", "ep", "cp"), to="varying")
             (total, count), _ = lax.scan(body, init, (ids, tgt))
@@ -510,7 +514,7 @@ def make_eval_step(cfg: Config, menv: MeshEnv):
         count = jnp.maximum(lax.psum(count, ("dp", "ep", "cp")), 1)
         return total / count
 
-    loss_fn_sharded = jax.shard_map(
+    loss_fn_sharded = compat.shard_map(
         _device_loss, mesh=menv.mesh,
         in_specs=(pspecs, (bspec, bspec)), out_specs=P())
     return jax.jit(loss_fn_sharded)

@@ -61,9 +61,10 @@ def fused_bwd_supported(cfg: Config) -> bool:
 
 
 def _vary_like(x, ref):
+    from picotron_tpu import compat
     from picotron_tpu.parallel.pp import _vary_over
 
-    return _vary_over(x, set(jax.typeof(ref).vma))
+    return _vary_over(x, set(compat.vma(ref)))
 
 
 def fused_micro_grads(params, ids, tgt, g_acc, cfg: Config,

@@ -63,6 +63,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from picotron_tpu import compat
 from picotron_tpu.config import Config
 from picotron_tpu.models.llama import (
     ParallelCtx, compute_dtype, embed, final_hidden, head_weight,
@@ -75,14 +76,14 @@ def _vary_over(x, want):
     """Promote x to vary over the mesh axes in `want` (no-op for axes it
     already varies over). Sound in the safe direction only: it forgets
     replication knowledge, never asserts it."""
-    have = jax.typeof(x).vma
+    have = compat.vma(x)
     missing = tuple(a for a in ("dp", "pp", "ep", "cp", "tp")
                     if a in want and a not in have)
-    return lax.pcast(x, missing, to="varying") if missing else x
+    return compat.pcast(x, missing, to="varying") if missing else x
 
 
 def _cast_varying_like(x, target):
-    return _vary_over(x, set(jax.typeof(target).vma))
+    return _vary_over(x, set(compat.vma(target)))
 
 
 def _boundary_axes(ctx) -> tuple:
@@ -151,7 +152,7 @@ def _make_stage_fn(ids, tgt, m, ctx: ParallelCtx, cos, sin, s_idx, pp):
         #    final norm) inside the branch makes shard_map insert the
         #    pvary there implicitly, whose transpose is again an in-branch
         #    psum — so promote them out here, where the psum is uniform.
-        y_vma = set(jax.typeof(y).vma)
+        y_vma = set(compat.vma(y))
         # the head weight source is lm_head, or the embedding when tied
         # (Qwen2-style) — promote whichever the scoring branch will read
         head_key = "lm_head" if "lm_head" in params else "embedding"
@@ -261,10 +262,10 @@ def pipeline_loss_sum_count(params, ids, tgt, cfg: Config, ctx: ParallelCtx):
 
     # Boundary buffers carry the residual stream, which sequence parallelism
     # shards to s_local / seq_shard (tp x less ppermute traffic per tick).
-    x0_buf = lax.pcast(
+    x0_buf = compat.pcast(
         jnp.zeros((mbs, s_local // ctx.seq_shard, m.hidden_size), dtype),
         _boundary_axes(ctx), to="varying")
-    init = (x0_buf,) + lax.pcast(
+    init = (x0_buf,) + compat.pcast(
         (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32),
          jnp.zeros((), jnp.float32)),
         ("dp", "ep", "cp", "pp"), to="varying")
@@ -402,10 +403,10 @@ def pipeline_1f1b_grads(params, ids, tgt, cfg: Config, ctx: ParallelCtx):
         return (ring, y_send, g_send, g_acc, nll_acc, cnt_acc, drop_acc), None
 
     x0 = jnp.zeros((mbs, s_local // ctx.seq_shard, m.hidden_size), dtype)
-    bufs = lax.pcast(
+    bufs = compat.pcast(
         (jnp.zeros((ring_slots,) + x0.shape, dtype), x0, x0),
         _boundary_axes(ctx), to="varying"
-    ) + lax.pcast(
+    ) + compat.pcast(
         (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32),
          jnp.zeros((), jnp.float32)),
         ("dp", "ep", "cp", "pp"), to="varying")
@@ -422,7 +423,7 @@ def pipeline_1f1b_grads(params, ids, tgt, cfg: Config, ctx: ParallelCtx):
     g_zero = jax.tree.map(
         lambda p: _vary_over(jnp.zeros(p.shape, jnp.float32),
                              set(_boundary_axes(ctx))
-                             | set(jax.typeof(p).vma)),
+                             | set(compat.vma(p))),
         params)
     init = (bufs[0], bufs[1], bufs[2], g_zero, bufs[3], bufs[4], bufs[5])
     (_, _, _, grads, nll_sum, cnt, dropw), _ = lax.scan(
@@ -465,9 +466,15 @@ def sync_sp_partial_grads(grads, params):
     partials — left untouched. No-op tree-wide when nothing is tp-varying
     beyond its param (the automatic pvary-transpose psum already ran, e.g.
     the AFAB jax.grad path)."""
+    # Which leaves are tp-PARTIAL (vs genuine tp shards) is read off the
+    # vma types — without them this sync cannot distinguish the two and
+    # would either drop or double-count the norm grads, so fail loudly
+    # rather than return silently-wrong gradients (compat module).
+    compat.require_vma("sequence_parallel gradient sync under pipeline "
+                       "parallelism (sync_sp_partial_grads)")
 
     def fix(g, p):
-        if "tp" in jax.typeof(g).vma and "tp" not in jax.typeof(p).vma:
+        if "tp" in compat.vma(g) and "tp" not in compat.vma(p):
             return lax.psum(g, "tp")
         return g
 

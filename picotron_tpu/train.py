@@ -106,6 +106,20 @@ def main(argv=None) -> None:
     menv = MeshEnv.from_config(cfg)
     t = cfg.training
 
+    # Fail-fast static pre-flight (tools/shardcheck.py is the full pass):
+    # spec lint + donation/recompile hazards catch a mis-authored
+    # PartitionSpec or a lost donation BEFORE any pod time is committed —
+    # every one of these previously surfaced as a partitioner error or an
+    # OOM at step 1 of a real run. Costs one extra abstract trace of the
+    # step (seconds, vs the compile that follows anyway); set
+    # PICOTRON_PREFLIGHT=0 to skip.
+    from picotron_tpu.analysis import preflight
+
+    if os.environ.get("PICOTRON_PREFLIGHT", "1") != "0":
+        pre = preflight(cfg, menv)  # raises ShardcheckError with the report
+        log_print(f"shardcheck preflight: ok "
+                  f"({len(pre.warnings())} warning(s))")
+
     n_chips = menv.world_size
     n_params = num_params(cfg.model)
     peak = device_peak_flops()

@@ -16,10 +16,20 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from picotron_tpu import compat
 from picotron_tpu.config import (
     Config, DistributedConfig, ModelConfig, TrainingConfig,
 )
 from tests.test_optimizer_offload import batch_for, run_steps
+
+# engine parity (fused == AD to bf16 tolerance) is only promised on the
+# vma shard_map type system — pre-vma JAX runs via compat.py's
+# check_rep=False fallback where grad-through-psum transposes are
+# axis-size-inflated, so the two engines legitimately diverge
+requires_vma = pytest.mark.skipif(
+    not compat.HAS_VMA,
+    reason="engine parity requires the vma shard_map type system "
+           "(see compat.py)")
 
 
 def engine_cfg(engine: str, model_kw=None, dist_kw=None, **tr) -> Config:
@@ -58,11 +68,13 @@ def assert_engines_match(mk=None, dk=None, **tr):
         np.testing.assert_allclose(a, b, rtol=3e-3, atol=3e-5)
 
 
+@requires_vma
 def test_parity_dense_dp():
     assert_engines_match()
 
 
 @pytest.mark.slow
+@requires_vma
 def test_parity_tp_vocab_parallel():
     # tp=2 exercises the ctx.f/g hook transposes and the vocab-parallel CE
     # inside the segment VJPs
@@ -70,6 +82,7 @@ def test_parity_tp_vocab_parallel():
 
 
 @pytest.mark.slow
+@requires_vma
 def test_parity_qwen_bias_tied():
     # qkv bias leaves + tied embeddings (head grads flow into the
     # embedding leaf through head_weight's transpose)
@@ -78,11 +91,13 @@ def test_parity_qwen_bias_tied():
 
 
 @pytest.mark.slow
+@requires_vma
 def test_parity_sdpa_path():
     assert_engines_match(mk=dict(attn_impl="reference"))
 
 
 @pytest.mark.slow
+@requires_vma
 def test_parity_without_offload():
     # the engine is independent of where the optimizer state lives
     assert_engines_match(optimizer_offload=False)
@@ -107,6 +122,7 @@ def test_fused_rejects_unsupported_config():
 
 
 @pytest.mark.slow
+@requires_vma
 def test_grad_clip_parity():
     # the global-norm clip consumes the accumulated grads — same totals,
     # same clip scale, regardless of engine

@@ -9,6 +9,7 @@ import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from picotron_tpu import compat
 from picotron_tpu.config import Config, DistributedConfig, ModelConfig, TrainingConfig
 from picotron_tpu.mesh import MeshEnv
 from picotron_tpu.models.llama import (
@@ -184,7 +185,7 @@ def test_vocab_parallel_embed_matches_lookup():
     w = jax.random.normal(jax.random.key(0), (64, 16))
     ids = jax.random.randint(jax.random.key(1), (2, 8), 0, 64)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(compat.shard_map(
         vocab_parallel_embed, mesh=menv.mesh,
         in_specs=(P("tp", None), P()), out_specs=P(),
     ))(w, ids)
@@ -198,7 +199,7 @@ def test_vocab_parallel_ce_matches_dense():
     tgt = jax.random.randint(jax.random.key(2), (2, 8), 0, 64)
     tgt = tgt.at[0, :2].set(-100)  # exercise ignore_index
 
-    loss = jax.jit(jax.shard_map(
+    loss = jax.jit(compat.shard_map(
         vocab_parallel_ce, mesh=menv.mesh,
         in_specs=(P(), P(None, "tp"), P()), out_specs=P(),
     ))(h, head, tgt)
@@ -206,6 +207,11 @@ def test_vocab_parallel_ce_matches_dense():
     np.testing.assert_allclose(float(loss), float(want), rtol=1e-5)
 
 
+@pytest.mark.skipif(
+    not compat.HAS_VMA,
+    reason="differentiates THROUGH the tp psum in vocab_parallel_ce: "
+           "pre-vma shard_map inflates the cotangent by the tp size "
+           "(see compat.py)")
 def test_vocab_parallel_ce_grad_matches_dense():
     menv = MeshEnv.create(tp=8)
     h = jax.random.normal(jax.random.key(0), (2, 8, 16))
@@ -215,7 +221,7 @@ def test_vocab_parallel_ce_grad_matches_dense():
     def sharded_loss(h, head):
         return vocab_parallel_ce(h, head, tgt)
 
-    g_par = jax.jit(jax.shard_map(
+    g_par = jax.jit(compat.shard_map(
         jax.grad(sharded_loss, argnums=(0, 1)), mesh=menv.mesh,
         in_specs=(P(), P(None, "tp")), out_specs=(P(), P(None, "tp")),
     ))(h, head)

@@ -9,6 +9,7 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from picotron_tpu import compat
 from picotron_tpu.mesh import MeshEnv
 from picotron_tpu.ops.attention import sdpa_attention
 from picotron_tpu.ops.ring_attention import ring_attention
@@ -27,7 +28,7 @@ def test_ring_matches_dense_forward(cp, hq, hkv):
     menv = MeshEnv.create(cp=cp)
     q, k, v = qkv(hq=hq, hkv=hkv)
 
-    ring = jax.jit(jax.shard_map(
+    ring = jax.jit(compat.shard_map(
         ring_attention, mesh=menv.mesh,
         in_specs=(P(None, "cp"), P(None, "cp"), P(None, "cp")),
         out_specs=P(None, "cp"),
@@ -38,6 +39,10 @@ def test_ring_matches_dense_forward(cp, hq, hkv):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.skipif(
+    not compat.HAS_VMA,
+    reason="differentiates THROUGH lax.psum: pre-vma shard_map inflates "
+           "the cotangent by the cp size (see compat.py)")
 def test_ring_matches_dense_grads():
     menv = MeshEnv.create(cp=4)
     q, k, v = qkv()
@@ -46,7 +51,7 @@ def test_ring_matches_dense_grads():
         out = ring_attention(q, k, v)
         return jax.lax.psum(jnp.sum(out ** 2), "cp")
 
-    g_ring = jax.jit(jax.shard_map(
+    g_ring = jax.jit(compat.shard_map(
         jax.grad(ring_loss, argnums=(0, 1, 2)), mesh=menv.mesh,
         in_specs=(P(None, "cp"),) * 3,
         out_specs=(P(None, "cp"),) * 3,
@@ -81,7 +86,7 @@ def test_ring_zigzag_layout_matches_dense():
     def ring_zz(q, k, v, pos):
         return ring_attention(q, k, v, q_positions=pos)
 
-    got = jax.jit(jax.shard_map(
+    got = jax.jit(compat.shard_map(
         ring_zz, mesh=menv.mesh,
         in_specs=(P(None, "cp"), P(None, "cp"), P(None, "cp"), P("cp")),
         out_specs=P(None, "cp"),
@@ -96,7 +101,7 @@ def test_ring_zigzag_layout_matches_dense():
 def test_ring_bf16_close_to_dense():
     menv = MeshEnv.create(cp=4)
     q, k, v = qkv(dtype=jnp.bfloat16)
-    ring = jax.jit(jax.shard_map(
+    ring = jax.jit(compat.shard_map(
         ring_attention, mesh=menv.mesh,
         in_specs=(P(None, "cp"),) * 3, out_specs=P(None, "cp"),
     ))

@@ -323,6 +323,24 @@ def test_trace_summary_tool(tmp_path, capsys):
     assert list(procs.values()) == ["/device:TPU:0"]
 
 
+def test_shardcheck_cli_smoke(capsys):
+    """tools/shardcheck.py end-to-end on the CPU backend: preset
+    resolution, the full analyzer stack, and the JSON output contract
+    (the acceptance-criteria entry point: `python tools/shardcheck.py
+    --preset ...` runs green without a TPU)."""
+    import json
+
+    sc = load_tool("shardcheck")
+    rc = sc.main(["--preset", "tiny-1chip", "--json"])
+    out = capsys.readouterr().out.strip().splitlines()
+    row = json.loads(out[-1])
+    assert rc == 0
+    assert row["config"] == "preset:tiny-1chip"
+    assert row["ok"] is True and row["errors"] == 0
+    assert row["info"]["donation"]["donated"] == \
+        row["info"]["donation"]["state_leaves"]
+
+
 def test_bench_decode_harness_smoke():
     """bench.run_decode end-to-end at debug-tiny scale on the CPU backend:
     the prefill/decode differencing, the JSON schema, and the

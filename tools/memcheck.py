@@ -76,6 +76,7 @@ def _field_is_str(dotted: str) -> bool:
     """True when the dotted config path names a str (or Optional[str])
     dataclass field — the cases where a bare-string --override value is
     legitimate. Unknown paths return False (loud beats silent)."""
+    import types
     import typing
 
     from picotron_tpu import config as cfg_mod
@@ -90,7 +91,10 @@ def _field_is_str(dotted: str) -> bool:
         return False
     if t is str:
         return True
-    return (typing.get_origin(t) is typing.Union
+    # both spellings of an optional/union string: typing.Optional[str]
+    # (origin typing.Union) and PEP 604 `str | None` (origin
+    # types.UnionType) — ADVICE r5
+    return (typing.get_origin(t) in (typing.Union, types.UnionType)
             and str in typing.get_args(t))
 
 

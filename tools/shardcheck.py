@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Will this config's SPMD program do what you think? — static audit, no TPU.
+
+Runs the shardcheck analyzers (picotron_tpu/analysis) for one or more
+configs by abstract evaluation on simulated host devices:
+
+- spec lint: PartitionSpec pytree vs param pytree vs mesh, path-level errors
+- collective-schedule audit: parse the lowered step's HLO — the grad
+  all-reduce over the fused data axes must exist, pipeline ppermutes and
+  expert all_to_alls must exist where the layout promises them, and no
+  all-gather may exceed the replication byte budget
+- donation + recompilation hazards: every TrainState buffer donated; the
+  step's output avals identical to its inputs (anything else recompiles
+  every step)
+- source lint: no semi-private jax.core, no host callbacks in library code
+
+Usage:
+
+  python tools/shardcheck.py --config runs/smollm17-dp8/config.json
+  python tools/shardcheck.py --preset tiny-dense --preset tiny-moe-ep
+  python tools/shardcheck.py --all-presets --verbose
+
+Exit status 0 iff every config is green. The preset matrix covers the
+layouts the test tier exercises (dense/MoE, pp>1, ep>1, offload on/off) on
+at most 8 simulated devices, so the whole matrix runs on a laptop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# (model, distributed kwargs, training kwargs) triples; every preset fits
+# the 8 simulated host devices the test tier provisions.
+PRESETS: dict[str, tuple[str, dict, dict]] = {
+    "tiny-1chip": ("debug-tiny", {}, {}),
+    "tiny-dense": ("debug-tiny",
+                   dict(dp_size=2, tp_size=2, cp_size=2),
+                   dict(gradient_accumulation_steps=2)),
+    "tiny-dense-pp": ("debug-tiny",
+                      dict(pp_size=2, dp_size=2),
+                      dict(gradient_accumulation_steps=2)),
+    "tiny-moe-ep": ("debug-tiny-moe",
+                    dict(ep_size=2, dp_size=2),
+                    dict(gradient_accumulation_steps=2)),
+    "tiny-dense-offload": ("debug-tiny", {},
+                           dict(gradient_accumulation_steps=2,
+                                optimizer_offload=True)),
+    "tiny-moe-offload": ("debug-tiny-moe", dict(ep_size=2),
+                         dict(gradient_accumulation_steps=2,
+                              optimizer_offload=True)),
+}
+
+
+def preset_config(name: str):
+    from picotron_tpu.config import (
+        Config, DistributedConfig, ModelConfig, TrainingConfig,
+        resolve_preset,
+    )
+
+    model, dist_kw, train_kw = PRESETS[name]
+    cfg = Config(
+        distributed=DistributedConfig(**dist_kw),
+        model=ModelConfig(name=model, **resolve_preset(model)),
+        training=TrainingConfig(seq_length=64, micro_batch_size=1,
+                                **train_kw),
+    )
+    cfg.validate()
+    return cfg
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="picotron-tpu static SPMD analysis (shardcheck)")
+    ap.add_argument("--config", action="append", default=[],
+                    help="config JSON path (repeatable)")
+    ap.add_argument("--preset", action="append", default=[],
+                    choices=sorted(PRESETS),
+                    help="built-in tiny config (repeatable)")
+    ap.add_argument("--all-presets", action="store_true",
+                    help="run the full preset matrix (dense/MoE, pp>1, "
+                         "ep>1, offload on/off)")
+    ap.add_argument("--checks", default=None,
+                    help="comma-separated subset of "
+                         "spec,source,collectives,donation,stability "
+                         "(default: all)")
+    ap.add_argument("--budget-mb", type=float, default=None,
+                    help="all-gather replication budget in MiB (default: "
+                         "the largest param leaf / activation block)")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON line per config instead of the report")
+    ap.add_argument("--verbose", action="store_true",
+                    help="include info-level findings and summary tables")
+    args = ap.parse_args(argv)
+
+    names = list(args.preset) + (sorted(PRESETS) if args.all_presets
+                                 else [])
+    if not names and not args.config:
+        ap.error("nothing to check: pass --config, --preset, or "
+                 "--all-presets")
+
+    from picotron_tpu.analysis import ALL_CHECKS, run_shardcheck
+    from picotron_tpu.config import load_config
+
+    checks = (tuple(c.strip() for c in args.checks.split(","))
+              if args.checks else ALL_CHECKS)
+    unknown = set(checks) - set(ALL_CHECKS)
+    if unknown:
+        ap.error(f"unknown checks {sorted(unknown)}; valid: {ALL_CHECKS}")
+    budget = (int(args.budget_mb * 1024 * 1024)
+              if args.budget_mb is not None else None)
+
+    targets = [(f"preset:{n}", preset_config(n)) for n in names]
+    targets += [(path, load_config(path)) for path in args.config]
+
+    # Simulate the largest topology on host CPUs — must precede the first
+    # backend-initializing jax call (same recipe as tools/memcheck.py).
+    world = max(cfg.distributed.world_size for _, cfg in targets)
+    from picotron_tpu.mesh import force_host_device_count
+
+    if world > 1:
+        force_host_device_count(world)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    n_bad = 0
+    for label, cfg in targets:
+        rep = run_shardcheck(cfg, checks=checks, budget_bytes=budget)
+        n_bad += 0 if rep.ok() else 1
+        if args.json:
+            print(json.dumps({
+                "config": label,
+                "ok": rep.ok(),
+                "errors": len(rep.errors()),
+                "warnings": len(rep.warnings()),
+                "findings": [f.render() for f in rep.findings
+                             if f.severity != "info" or args.verbose],
+                "info": rep.info,
+            }), flush=True)
+        else:
+            print(f"== {label} ==")
+            print(rep.render(verbose=args.verbose), flush=True)
+    if not args.json:
+        status = "green" if n_bad == 0 else f"{n_bad} config(s) with errors"
+        print(f"shardcheck: {len(targets)} config(s) checked — {status}")
+    return 0 if n_bad == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
