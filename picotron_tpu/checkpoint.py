@@ -25,6 +25,7 @@ Capability parity with the reference's checkpoint layer
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import re
@@ -35,6 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from picotron_tpu.config import Config, ModelConfig
+from picotron_tpu.resilience import chaos
+from picotron_tpu.resilience.retry import RetryPolicy, retry_call
 from picotron_tpu.train_step import TrainState
 
 
@@ -96,6 +99,15 @@ class CheckpointManager:
             self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
         else:
             self._ckptr = ocp.StandardCheckpointer()
+        # Flaky-store retry policy (resilience config): save/restore and
+        # the durability probe all ride it. The probe variant keeps the
+        # attempt budget but caps the delays — latest_step() probes every
+        # step dir, and a 30 s backoff per dir would stall resume.
+        self._retry = RetryPolicy.from_config(cfg.resilience)
+        self._probe_retry = dataclasses.replace(
+            self._retry,
+            base_delay=min(self._retry.base_delay, 0.2),
+            max_delay=min(self._retry.max_delay, 1.0))
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step:08d}")
@@ -107,28 +119,39 @@ class CheckpointManager:
         self._ckptr.wait_until_finished()
         step = int(state.step)
         path = self._step_dir(step)
-        self._ckptr.save(
-            os.path.join(path, "state"),
-            {"params": state.params, "opt_state": state.opt_state,
-             "step": state.step},
-            force=True,
-        )
-        if not self.cfg.checkpoint.async_save:
-            self._ckptr.wait_until_finished()
-        if jax.process_index() == 0:
-            # Orbax coordinates the sharded array write across hosts; the
-            # sidecar metadata must be written once, not per-host. Written
-            # immediately (even mid-async-write): durability is judged by
-            # the finalized `state` dir (latest_step), not by meta.json.
-            meta = {
-                "step": step,
-                "trained_tokens": int(trained_tokens),
-                "config": self.cfg.to_json_dict(),
-            }
-            if dataloader_state is not None:
-                meta["dataloader"] = dataloader_state
-            with open(os.path.join(path, "meta.json"), "w") as f:
-                json.dump(meta, f, indent=2)
+
+        def _write():
+            # Chaos injection + retry sit around the whole write so a
+            # transient store failure (or an injected one) costs a
+            # backoff, not the run; force=True makes the re-save of a
+            # partially staged attempt idempotent.
+            chaos.fire("ckpt_save", step=step)
+            self._ckptr.save(
+                os.path.join(path, "state"),
+                {"params": state.params, "opt_state": state.opt_state,
+                 "step": state.step},
+                force=True,
+            )
+            if not self.cfg.checkpoint.async_save:
+                self._ckptr.wait_until_finished()
+            if jax.process_index() == 0:
+                # Orbax coordinates the sharded array write across hosts;
+                # the sidecar metadata must be written once, not per-host.
+                # Written immediately (even mid-async-write): durability
+                # is judged by the finalized `state` dir (latest_step),
+                # not by meta.json.
+                meta = {
+                    "step": step,
+                    "trained_tokens": int(trained_tokens),
+                    "config": self.cfg.to_json_dict(),
+                }
+                if dataloader_state is not None:
+                    meta["dataloader"] = dataloader_state
+                with open(os.path.join(path, "meta.json"), "w") as f:
+                    json.dump(meta, f, indent=2)
+
+        retry_call(_write, policy=self._retry,
+                   describe=f"checkpoint save (step {step})")
         return path
 
     def wait_until_finished(self) -> None:
@@ -148,7 +171,14 @@ class CheckpointManager:
         if not _isdir(state_dir):
             return False
         try:
-            return bool(self._ocp.utils.is_checkpoint_finalized(state_dir))
+            # The probe itself retries transient store errors (short
+            # backoff) — the general form of the old one-shot
+            # _probe_failed: a 2-second GCS blip while listing steps must
+            # not hide a durable checkpoint from auto_resume.
+            return bool(retry_call(
+                self._ocp.utils.is_checkpoint_finalized, state_dir,
+                policy=self._probe_retry,
+                describe=f"durability probe {step_dirname}"))
         except ValueError as e:
             # "not an Orbax-managed checkpoint path" (older Orbax APIs).
             # json.JSONDecodeError subclasses ValueError, so a torn
@@ -203,8 +233,13 @@ class CheckpointManager:
                 raise FileNotFoundError(
                     f"no checkpoints under {self.directory}")
         path = self._step_dir(step)
-        with open(os.path.join(path, "meta.json")) as f:
-            meta = json.load(f)
+
+        def _read_meta():
+            with open(os.path.join(path, "meta.json")) as f:
+                return json.load(f)
+
+        meta = retry_call(_read_meta, policy=self._retry,
+                          describe=f"checkpoint meta read (step {step})")
         # Checkpoints store the PP-padded layer stack. Even splits are
         # canonical (no padding), so any-topology restore works; an uneven
         # split bakes its pp into the padded shape, which a different pp
@@ -242,7 +277,10 @@ class CheckpointManager:
             if hasattr(x, "sharding") else x,
             template,
         )
-        restored = self._ckptr.restore(os.path.join(path, "state"), abstract)
+        restored = retry_call(
+            self._ckptr.restore, os.path.join(path, "state"), abstract,
+            policy=self._retry,
+            describe=f"checkpoint restore (step {step})")
         # Force every leaf onto the template's sharding: Orbax can hand back
         # differently-placed arrays (e.g. scalar opt-state counters on a
         # single device), which would fail jit's consistent-devices check on

@@ -561,6 +561,78 @@ class CheckpointConfig:
 
 
 @dataclass(frozen=True)
+class ResilienceConfig:
+    """Runtime fault tolerance (picotron_tpu/resilience; beyond the
+    reference, whose loop dies on the first NaN, hang, or preemption).
+    See README "Fault tolerance" for the recovery matrix."""
+
+    # Fault-injection spec, e.g. "sigterm@3,ckpt_io@2x2" (resilience/
+    # chaos.py documents the grammar). Empty = no injection. The
+    # PICOTRON_CHAOS env var, when set, overrides this field.
+    chaos: str = ""
+    # Response to a tripped divergence guard (non-finite loss/grads, loss
+    # spike): "skip" drops the batch but keeps optimizer state (the
+    # non-finite half runs inside the jitted step), "rollback" restores
+    # the last durable checkpoint and skips past the poison data range,
+    # "abort" exits EXIT_DIVERGED (76), "off" disables the guards — and
+    # with them the per-step host sync they require; use "off" to recover
+    # fully-async stepping when logging is sparse.
+    guard_policy: str = "abort"
+    # Rolling loss-spike detection: trip when the loss sits spike_zscore
+    # standard deviations above the mean of the last spike_window healthy
+    # steps. 0 disables (non-finite detection stays on).
+    spike_zscore: float = 0.0
+    spike_window: int = 32
+    # Consecutive guard trips before escalating to abort regardless of
+    # policy — a guard that keeps tripping is not recovering.
+    max_guard_trips: int = 3
+    # Retry-with-backoff policy for flaky I/O (checkpoint save/restore,
+    # durability probes, dataset reads): total attempts and the
+    # exponential-backoff delay bounds in seconds.
+    retry_attempts: int = 3
+    retry_base_delay: float = 0.5
+    retry_max_delay: float = 30.0
+    # Seconds without step-loop progress before the watchdog dumps all
+    # thread stacks and exits EXIT_WATCHDOG (77) for a supervisor
+    # restart. 0 disables. Armed only after the first step completes
+    # (step 1 includes unbounded XLA compile time); set it well above a
+    # normal step + checkpoint write.
+    watchdog_timeout: float = 0.0
+
+    def validate(self) -> None:
+        if self.guard_policy not in ("off", "skip", "rollback", "abort"):
+            raise ValueError(
+                f"guard_policy must be off/skip/rollback/abort, got "
+                f"{self.guard_policy!r}")
+        if self.spike_zscore < 0:
+            raise ValueError(
+                f"spike_zscore must be >= 0, got {self.spike_zscore}")
+        if self.spike_window < 4:
+            # fewer points make the z-score statistically meaningless and
+            # trip on ordinary early-training descent
+            raise ValueError(
+                f"spike_window must be >= 4, got {self.spike_window}")
+        if self.max_guard_trips < 1:
+            raise ValueError(
+                f"max_guard_trips must be >= 1, got {self.max_guard_trips}")
+        if self.retry_attempts < 1:
+            raise ValueError(
+                f"retry_attempts must be >= 1, got {self.retry_attempts}")
+        if self.retry_base_delay < 0 or self.retry_max_delay < self.retry_base_delay:
+            raise ValueError(
+                f"retry delays must satisfy 0 <= base <= max, got "
+                f"base={self.retry_base_delay} max={self.retry_max_delay}")
+        if self.watchdog_timeout < 0:
+            raise ValueError(
+                f"watchdog_timeout must be >= 0, got {self.watchdog_timeout}")
+        if self.chaos:
+            # Parse errors at config load, not at step N mid-run.
+            from picotron_tpu.resilience.chaos import parse_spec
+
+            parse_spec(self.chaos)
+
+
+@dataclass(frozen=True)
 class LoggingConfig:
     """(ref: template/base_config.json:41-45)."""
 
@@ -585,6 +657,7 @@ class Config:
     dataset: DatasetConfig = field(default_factory=DatasetConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
     # -- derived quantities (ref: data.py:17-20) --
 
@@ -605,7 +678,17 @@ class Config:
     def validate(self) -> None:
         self.distributed.validate()
         self.model.validate()
+        self.resilience.validate()
         d, m, t = self.distributed, self.model, self.training
+        if self.resilience.guard_policy == "skip" and t.optimizer_offload:
+            # The in-jit skip selects the pre-update params/opt state,
+            # but the offload update streams the host master in place —
+            # there is no pre-update tree left to select.
+            raise ValueError(
+                "resilience.guard_policy='skip' is not supported with "
+                "training.optimizer_offload (the streamed host-master "
+                "update cannot be un-applied in-step); use 'rollback' "
+                "or 'abort'")
         if m.num_attention_heads % d.tp_size != 0:
             raise ValueError("num_attention_heads must be divisible by tp_size")
         if m.num_key_value_heads % d.tp_size != 0:
@@ -827,6 +910,7 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
         dataset=DatasetConfig(**_filter_kwargs(DatasetConfig, raw.get("dataset", {}))),
         checkpoint=CheckpointConfig(**_filter_kwargs(CheckpointConfig, raw.get("checkpoint", {}))),
         logging=LoggingConfig(**_filter_kwargs(LoggingConfig, raw.get("logging", {}))),
+        resilience=ResilienceConfig(**_filter_kwargs(ResilienceConfig, raw.get("resilience", {}))),
     )
     cfg.validate()
     return cfg

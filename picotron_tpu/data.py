@@ -39,6 +39,8 @@ import jax
 import numpy as np
 
 from picotron_tpu.config import Config
+from picotron_tpu.resilience import chaos
+from picotron_tpu.resilience.retry import RetryPolicy, retry_call
 
 
 class _ProducerError:
@@ -249,6 +251,15 @@ class MicroBatchDataLoader:
         self._prefetch_depth = cfg.dataset.num_workers
         self._queue = None  # created lazily on first __next__
         self._producer_exc = None  # set once the prefetch thread dies
+        # Transient source-I/O retry (resilience config) around batch
+        # assembly, in both the sync and prefetch paths.
+        self._retry = RetryPolicy.from_config(cfg.resilience)
+        # Global batch ordinal (1-based, derived from the data position so
+        # it survives resume) — the deterministic key chaos data events
+        # fire on: a resumed run past an injected stall does not re-stall.
+        self._steps_per_epoch = max(1, len(self.source)
+                                    // self.global_batch_size)
+        self._batch_index = 0
 
     # -- resume position (persisted in checkpoint meta; ADVICE r1) --------
 
@@ -267,6 +278,22 @@ class MicroBatchDataLoader:
         self.epoch = int(st["epoch"])
         self.cursor = int(st["cursor"])
         self._consumed_state = {"epoch": self.epoch, "cursor": self.cursor}
+        self._batch_index = (self.epoch * self._steps_per_epoch
+                             + self.cursor // self.global_batch_size)
+
+    def reset(self, st: dict) -> None:
+        """Reposition mid-run (the divergence guard's rollback path: jump
+        past a poison data range). Stops the prefetch thread and drops any
+        queued batches first — they were assembled beyond the old cursor
+        and must not leak into the repositioned stream."""
+        if self._queue is not None:
+            self._stop.set()
+            self._thread.join(timeout=10.0)
+            # _produce captured this queue/stop pair by argument; a thread
+            # still draining a chaos stall can only touch the old pair.
+            self._queue = None
+            self._producer_exc = None
+        self.set_state(st)
 
     def _build_source(self):
         d = self.cfg.dataset
@@ -322,13 +349,19 @@ class MicroBatchDataLoader:
         return self
 
     def _assemble_next(self):
-        """Produce the next (batch, post_state) at the production cursor."""
+        """Produce the next (batch, post_state) at the production cursor.
+        Idempotent under retry: the cursor/batch-index advance only after
+        the source read succeeds (and the epoch-bump re-check is a no-op
+        on re-entry), so a failed attempt re-assembles the same batch."""
+        idx = self._batch_index + 1
+        chaos.fire("data_produce", step=idx)
         n = self.global_batch_size
         if self.cursor + n > len(self.source):
             self.epoch += 1  # ref: data.py:129-133 epoch bump
             self.cursor = 0
         rows = self.source.get_rows(self.epoch, self.cursor, n)
         self.cursor += n
+        self._batch_index = idx
         t = self.cfg.training
         blocks = rows.reshape(
             t.gradient_accumulation_steps,
@@ -361,18 +394,28 @@ class MicroBatchDataLoader:
         return jax.make_array_from_callback(
             arr.shape, self.sharding, lambda idx: arr[idx])
 
-    def _produce(self):
-        while not self._stop.is_set():
+    def _assemble_with_retry(self):
+        """Batch assembly under the transient-I/O retry policy (OSError
+        only — a logic error in the source must still fail fast)."""
+        return retry_call(self._assemble_next, policy=self._retry,
+                          describe="batch assembly")
+
+    def _produce(self, queue, stop):
+        # queue/stop arrive as arguments, not via self: after a reset()
+        # the loader starts a fresh pair, and a previous thread still
+        # unwinding (e.g. out of a chaos stall) must keep talking to its
+        # own — stale — queue rather than feed the repositioned stream.
+        while not stop.is_set():
             try:
-                item = self._assemble_next()
+                item = self._assemble_with_retry()
             except BaseException as e:  # noqa: BLE001 — relayed to consumer
                 # A dead producer must not leave the consumer blocked on an
                 # empty queue forever; ship the exception as an item and let
                 # __next__ re-raise it on the training thread.
                 item = _ProducerError(e)
-            while not self._stop.is_set():
+            while not stop.is_set():
                 try:
-                    self._queue.put(item, timeout=0.5)
+                    queue.put(item, timeout=0.5)
                     break
                 except queue_mod.Full:
                     continue
@@ -388,8 +431,9 @@ class MicroBatchDataLoader:
             if self._queue is None:
                 self._queue = queue_mod.Queue(maxsize=self._prefetch_depth)
                 self._stop = threading.Event()
-                self._thread = threading.Thread(target=self._produce,
-                                                daemon=True)
+                self._thread = threading.Thread(
+                    target=self._produce, args=(self._queue, self._stop),
+                    daemon=True, name="picotron-data-producer")
                 self._thread.start()
             if self._producer_exc is not None:  # producer already dead
                 raise RuntimeError(
@@ -403,6 +447,6 @@ class MicroBatchDataLoader:
                     "dataloader prefetch thread died") from got.exc
             batch, post_state = got
         else:
-            batch, post_state = self._assemble_next()
+            batch, post_state = self._assemble_with_retry()
         self._consumed_state = post_state
         return batch

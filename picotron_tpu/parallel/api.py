@@ -53,7 +53,7 @@ from picotron_tpu.parallel.tp import (
     vocab_parallel_ce_sum_count,
     vocab_parallel_embed,
 )
-from picotron_tpu.train_step import TrainState
+from picotron_tpu.train_step import TrainState, guard_nonfinite
 
 
 def make_parallel_ctx(cfg: Config) -> ParallelCtx:
@@ -388,18 +388,36 @@ def _finish_grads(grads, nll_total, count, dropw, cfg: Config):
             extras)
 
 
-def make_train_step(cfg: Config, menv: MeshEnv):
+def make_train_step(cfg: Config, menv: MeshEnv, inject_nan: bool = False):
     """Build the jitted (TrainState, batch) -> (TrainState, metrics) step
     over the mesh. batch = (input_ids, targets), each
     [n_micro, global_b, seq] sharded P(None, ('dp', 'ep'), 'cp').
 
     metrics is a dict with at least {"loss"}; MoE runs additionally carry
     {"moe_drop_frac"} (the capacity-drop observability scalar — VERDICT r2
-    weak #4: drops used to be silent in training logs)."""
+    weak #4: drops used to be silent in training logs). With
+    resilience.guard_policy != "off" it also carries {"grad_norm",
+    "nonfinite"} — the divergence guard's inputs — and under policy
+    "skip" a non-finite loss/grad step keeps params and optimizer state
+    unchanged (train_step.guard_nonfinite; the step counter still
+    advances).
+
+    `inject_nan=True` poisons every step's gradients and loss — the
+    chaos harness's nan_grad event (the driver routes only the injected
+    steps through this variant). Injection must live inside the compiled
+    step: it is the only way the in-jit skip path sees a genuinely
+    non-finite gradient tree."""
     cfg.validate()
     mesh = menv.mesh
     pspecs = param_specs(cfg)
     bspec = batch_spec()
+    guards_on = cfg.resilience.guard_policy != "off"
+    guard_skip = cfg.resilience.guard_policy == "skip"
+
+    def _poison(grads, loss):
+        nan = jnp.float32(jnp.nan)
+        grads = jax.tree.map(lambda g: g + nan.astype(g.dtype), grads)
+        return grads, loss + nan
 
     grad_fn = compat.shard_map(
         partial(_device_grads, cfg=cfg),
@@ -438,6 +456,8 @@ def make_train_step(cfg: Config, menv: MeshEnv):
 
         def _device_step(params, batch, opt_state):
             grads, loss, extras = _device_grads(params, batch, cfg)
+            if inject_nan:
+                grads, loss = _poison(grads, loss)
             grad_scale = extras.pop("_grad_scale")
             new_params, new_opt = offload_adam_update(
                 grads, opt_state, cfg.training, cdt, transfer=transfer,
@@ -465,6 +485,14 @@ def make_train_step(cfg: Config, menv: MeshEnv):
                 new_params = jax.lax.with_sharding_constraint(
                     new_params, full_shardings)
             metrics = {"loss": loss, **extras}
+            if guards_on:
+                # Offload guards key on the (already psum'd) loss only: a
+                # per-shard global grad norm would need the clip_specs
+                # psum machinery for no policy benefit — 'skip' is
+                # rejected for offload at config time, and rollback/abort
+                # both trigger off the loss.
+                metrics["nonfinite"] = (
+                    1.0 - jnp.isfinite(loss).astype(jnp.float32))
             return TrainState(new_params, new_opt, state.step + 1), metrics
 
         return step
@@ -474,8 +502,23 @@ def make_train_step(cfg: Config, menv: MeshEnv):
     @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, batch):
         grads, loss, extras = grad_fn(state.params, batch)
+        if inject_nan:
+            grads, loss = _poison(grads, loss)
+        if guards_on:
+            # One global norm covers the whole tree: any NaN/Inf leaf
+            # poisons it, so non-finite detection is a single scalar
+            # check instead of a per-leaf isfinite sweep. Surfaced as a
+            # metric either way — grad-norm curves are standard
+            # divergence forensics.
+            gnorm = optax.global_norm(grads)
+            ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+            extras = {**extras, "grad_norm": gnorm,
+                      "nonfinite": 1.0 - ok.astype(jnp.float32)}
         updates, opt_state = opt.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        if guards_on and guard_skip:
+            new_params = guard_nonfinite(ok, new_params, state.params)
+            opt_state = guard_nonfinite(ok, opt_state, state.opt_state)
         metrics = {"loss": loss, **extras}
         return TrainState(new_params, opt_state, state.step + 1), metrics
 

@@ -1,0 +1,54 @@
+"""Runtime resilience: the dynamic half of failure handling.
+
+PR 1 (shardcheck) made *static* failures cheap to catch before a run; this
+package does the same for *runtime* failures — the things that actually end
+long TPU pre-training runs in practice:
+
+- **preemption** (SIGTERM from the scheduler): `PreemptionHandler` catches
+  the signal, the driver finishes the in-flight step, writes an emergency
+  checkpoint (with the dataloader position) inside the grace window, and
+  exits `EXIT_PREEMPTED` so an external supervisor resubmits into
+  `checkpoint.auto_resume`.
+- **divergence** (NaN/Inf loss or grads, loss spikes): `DivergenceGuard`
+  watches the step metrics and answers skip / rollback / abort per the
+  configured policy (`resilience.guard_policy`); the in-jit half of `skip`
+  lives in `train_step.guard_nonfinite`.
+- **flaky I/O** (checkpoint stores, dataset reads): `retry_call` wraps the
+  checkpoint save/restore and dataloader production paths with exponential
+  backoff + jitter — the generalization of checkpoint.py's old one-shot
+  `_probe_failed` durability probe.
+- **hangs** (stuck collective, stalled data producer): `Watchdog` watches
+  for step-loop progress, dumps every thread's Python stack on timeout, and
+  exits `EXIT_WATCHDOG` non-zero so the supervisor restarts the job.
+- **testability**: `chaos` injects each of these failures deterministically
+  by step (`PICOTRON_CHAOS` / `resilience.chaos`), so every recovery path
+  above runs on CPU in tier-1 instead of being exercised for the first time
+  by a real outage. `tools/chaos.py` drives whole-scenario recovery runs.
+
+Exit codes are the contract with the external supervisor (distinct from
+Python's generic 1 so a wrapper script can distinguish "resubmit me"
+from "a human must look"): 75 preempted-with-durable-state, 76 diverged,
+77 watchdog-killed. See README "Fault tolerance" for the recovery matrix.
+"""
+
+from picotron_tpu.resilience import chaos
+from picotron_tpu.resilience.guards import (
+    EXIT_DIVERGED, DivergenceGuard, GuardAction,
+)
+from picotron_tpu.resilience.preemption import EXIT_PREEMPTED, PreemptionHandler
+from picotron_tpu.resilience.retry import RetryPolicy, backoff_delays, retry_call
+from picotron_tpu.resilience.watchdog import EXIT_WATCHDOG, Watchdog
+
+__all__ = [
+    "EXIT_DIVERGED",
+    "EXIT_PREEMPTED",
+    "EXIT_WATCHDOG",
+    "DivergenceGuard",
+    "GuardAction",
+    "PreemptionHandler",
+    "RetryPolicy",
+    "Watchdog",
+    "backoff_delays",
+    "chaos",
+    "retry_call",
+]

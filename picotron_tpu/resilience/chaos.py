@@ -1,0 +1,185 @@
+"""Deterministic fault injection — make every recovery path testable on CPU.
+
+A chaos spec is a comma-separated list of events, each
+
+    KIND@STEP[xCOUNT][~SECS]
+
+- ``KIND``: one of ``sigterm`` / ``sigint`` (deliver that signal to this
+  process at the start of step STEP — exercises the real preemption
+  handler), ``hang`` (sleep SECS in the step loop at step STEP),
+  ``ckpt_io`` (raise OSError from the next COUNT checkpoint-save attempts
+  at step STEP — exercises the save retry), ``data_io`` (same for the next
+  COUNT batch-assembly attempts at *batch* STEP), ``data_stall`` (sleep
+  SECS while producing batch STEP — exercises the watchdog), and
+  ``nan_grad`` (poison the gradients/loss of COUNT step executions
+  starting at the first execution of step STEP — a budget, so a
+  guard-rollback re-run of the same step number does not re-fire;
+  exercises the divergence guard; injected inside the jitted step via
+  ``make_train_step(..., inject_nan=True)``).
+- ``xCOUNT`` defaults to 1; ``~SECS`` defaults to 0 and is required for the
+  sleep kinds.
+
+Examples: ``sigterm@3``, ``ckpt_io@2x2,nan_grad@4``, ``data_stall@3~10``.
+
+The spec comes from ``resilience.chaos`` in the config; the
+``PICOTRON_CHAOS`` environment variable, when set (even to the empty
+string), overrides it — that is how a supervisor restarts a chaos run
+without the fault recurring. Events key on the step/batch *number*, so
+injection is deterministic and identical across processes of a multi-host
+run (every process self-delivers its SIGTERM at the same step, the way a
+real preemption hits every host of a pod at once).
+
+Injection points call `fire(point, step)`; an inactive controller (the
+default) makes those calls free, so library code carries the hooks
+unconditionally.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import signal
+import sys
+import time
+from dataclasses import dataclass, field
+
+KINDS = ("sigterm", "sigint", "hang", "ckpt_io", "data_io", "data_stall",
+         "nan_grad")
+
+# Which event kinds an injection point can trigger. "nan_grad" has no fire
+# point: the driver reads nan_grad_steps() and routes those steps through
+# the poisoned jitted step instead (a host-side hook cannot reach inside
+# the compiled program).
+_POINT_KINDS = {
+    "step_begin": ("sigterm", "sigint", "hang"),
+    "ckpt_save": ("ckpt_io",),
+    "data_produce": ("data_io", "data_stall"),
+}
+
+_EVENT_RE = re.compile(
+    r"^(?P<kind>[a-z_]+)@(?P<step>\d+)"
+    r"(?:x(?P<count>\d+))?(?:~(?P<secs>\d+(?:\.\d+)?))?$")
+
+
+@dataclass
+class ChaosEvent:
+    kind: str
+    step: int          # 1-based training step (or batch number for data_*)
+    count: int = 1     # xN: firings before the event is exhausted
+    secs: float = 0.0  # ~S: sleep duration for hang / data_stall
+    fired: int = field(default=0, compare=False)
+
+
+def parse_spec(spec: str) -> list[ChaosEvent]:
+    """Parse a chaos spec; raises ValueError naming the bad event."""
+    events = []
+    for item in (spec or "").replace(" ", "").split(","):
+        if not item:
+            continue
+        m = _EVENT_RE.match(item)
+        if not m:
+            raise ValueError(
+                f"bad chaos event {item!r}: expected KIND@STEP[xCOUNT][~SECS]"
+                f" with KIND in {KINDS}")
+        kind = m.group("kind")
+        if kind not in KINDS:
+            raise ValueError(
+                f"unknown chaos kind {kind!r} in {item!r}; known: {KINDS}")
+        secs = float(m.group("secs") or 0.0)
+        if kind in ("hang", "data_stall") and secs <= 0:
+            raise ValueError(
+                f"chaos event {item!r} needs a ~SECS duration (e.g. "
+                f"{kind}@{m.group('step')}~5)")
+        events.append(ChaosEvent(kind=kind, step=int(m.group("step")),
+                                 count=int(m.group("count") or 1), secs=secs))
+    return events
+
+
+def _log(msg: str) -> None:
+    # stderr, every process: chaos firings must be visible even from
+    # non-logging hosts (they are the whole point of a chaos run).
+    print(f"[chaos] {msg}", file=sys.stderr, flush=True)
+
+
+class ChaosController:
+    def __init__(self, events: list[ChaosEvent]):
+        self.events = list(events)
+
+    @property
+    def active(self) -> bool:
+        return bool(self.events)
+
+    def describe(self) -> str:
+        return ", ".join(
+            f"{e.kind}@{e.step}" + (f"x{e.count}" if e.count > 1 else "")
+            + (f"~{e.secs:g}" if e.secs else "")
+            for e in self.events)
+
+    def has_nan_grad(self) -> bool:
+        """True when the spec names any nan_grad event — the driver then
+        compiles the poisoned step twin."""
+        return any(e.kind == "nan_grad" for e in self.events)
+
+    def poison_step(self, step: int) -> bool:
+        """Should this step execution run with poisoned gradients?
+        nan_grad@S xN fires on the first N step *executions* starting at
+        the first execution of step S — a budget, not a step predicate:
+        after a guard rollback re-runs step S (on the post-poison data the
+        rollback skipped to), an exhausted event must not re-fire, or the
+        run would re-live the same divergence forever."""
+        for e in self.events:
+            if e.kind != "nan_grad" or e.fired >= e.count:
+                continue
+            if e.fired > 0 or step == e.step:
+                e.fired += 1
+                _log(f"poisoning gradients at step {step} "
+                     f"({e.fired}/{e.count})")
+                return True
+        return False
+
+    def fire(self, point: str, step: int) -> None:
+        """Trigger any event bound to `point` whose step matches and whose
+        firing budget is not exhausted. May sleep, raise OSError, or
+        deliver a signal to this process."""
+        for e in self.events:
+            if (e.kind not in _POINT_KINDS.get(point, ())
+                    or e.step != step or e.fired >= e.count):
+                continue
+            e.fired += 1
+            _log(f"firing {e.kind} at {point} step {step} "
+                 f"({e.fired}/{e.count})")
+            if e.kind in ("sigterm", "sigint"):
+                os.kill(os.getpid(),
+                        signal.SIGTERM if e.kind == "sigterm"
+                        else signal.SIGINT)
+            elif e.kind in ("hang", "data_stall"):
+                time.sleep(e.secs)
+            else:  # ckpt_io / data_io
+                raise OSError(
+                    f"chaos-injected {e.kind} failure at {point} "
+                    f"step {step} ({e.fired}/{e.count})")
+
+
+# Module-level controller: library injection points (checkpoint.py,
+# data.py) reach chaos without any plumbing; train.main installs per run.
+_controller = ChaosController([])
+
+
+def install(spec: str = "") -> ChaosController:
+    """Activate chaos for this process. `spec` is the config's
+    resilience.chaos; PICOTRON_CHAOS, when set, wins (empty value =
+    disable — the supervisor-restart story)."""
+    env = os.environ.get("PICOTRON_CHAOS")
+    if env is not None:
+        spec = env
+    global _controller
+    _controller = ChaosController(parse_spec(spec))
+    return _controller
+
+
+def controller() -> ChaosController:
+    return _controller
+
+
+def fire(point: str, step: int) -> None:
+    _controller.fire(point, step)

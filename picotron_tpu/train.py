@@ -9,6 +9,16 @@ What disappears relative to the reference: torchrun rank choreography, the
 rank-0 config/tokenizer broadcasts (ref: train.py:152-165, data.py:23-32),
 device placement flags, and the env-var dispatch channel — one process per
 host runs ordinary Python and every collective lives inside the jitted step.
+
+What the reference's loop lacks entirely: runtime fault tolerance. The step
+loop here is wired through picotron_tpu/resilience — SIGTERM/SIGINT land as
+a finished step + emergency checkpoint + exit 75 (auto_resume recovers
+losslessly), divergence guards answer NaN/spike steps with skip / rollback /
+abort, checkpoint and dataset I/O retry with backoff, and a watchdog turns a
+hung step or stalled producer into a stack dump + exit 77 instead of a
+silently burning reservation. All of it is testable on CPU via the chaos
+harness (PICOTRON_CHAOS / resilience.chaos; tools/chaos.py runs whole
+fault-recovery scenarios). See README "Fault tolerance".
 """
 
 from __future__ import annotations
@@ -26,6 +36,10 @@ from picotron_tpu.data import MicroBatchDataLoader
 from picotron_tpu.mesh import MeshEnv, multihost_initialize
 from picotron_tpu.parallel.api import (
     init_sharded_state, install_params, make_train_step,
+)
+from picotron_tpu.resilience import (
+    EXIT_DIVERGED, EXIT_PREEMPTED, DivergenceGuard, GuardAction,
+    PreemptionHandler, Watchdog, chaos,
 )
 from picotron_tpu.train_step import TrainState
 from picotron_tpu.utils import (
@@ -71,6 +85,46 @@ def build_state(cfg: Config, menv: MeshEnv) \
                   f"{int(state.step)} ({human_format(tokens)} tokens)")
         return state, int(state.step), tokens, meta, load_dir
     return state, 0, 0, {}, ""
+
+
+def _emergency_checkpoint(cfg, menv, ckpt_mgr, state, trained_tokens, dl,
+                          saved_steps):
+    """Preemption landed: make the in-flight progress durable inside the
+    grace window. Builds a manager on the spot when periodic saving was
+    off — an emergency save must not depend on save_frequency."""
+    mgr = ckpt_mgr if ckpt_mgr is not None else CheckpointManager(cfg, menv)
+    step = int(state.step)
+    if step not in saved_steps:
+        path = mgr.save(state, trained_tokens, dataloader_state=dl.state)
+        saved_steps.add(step)
+        log_print(f"emergency checkpoint -> {path}")
+    mgr.wait_until_finished()
+    return mgr
+
+
+def _rollback(ckpt_mgr, state, dl, step, trained_tokens, why):
+    """Divergence-guard rollback: restore the last durable checkpoint and
+    reposition the dataloader to the cursor AFTER the poison batch, so the
+    resumed steps skip the data range that tripped the guard. Returns the
+    restored (state, step, trained_tokens); escalates to EXIT_DIVERGED
+    when there is nothing durable to roll back to."""
+    if ckpt_mgr is None or ckpt_mgr.latest_step() is None:
+        log_print(f"[guard {step:06d}] {why}; rollback requested but no "
+                  f"durable checkpoint exists — aborting "
+                  f"(exit {EXIT_DIVERGED})")
+        raise SystemExit(EXIT_DIVERGED)
+    skip_to = dl.state  # position after the poison batch
+    ckpt_mgr.wait_until_finished()
+    state, meta = ckpt_mgr.restore(state)
+    restored = int(state.step)
+    dl.reset(skip_to)
+    tokens = int(meta.get("trained_tokens", 0))
+    log_print(f"[guard {step:06d}] {why}; rolled back to step {restored} "
+              f"(skipping poisoned data through "
+              f"epoch {skip_to['epoch']} cursor {skip_to['cursor']}); "
+              f"was {human_format(trained_tokens)} tokens, "
+              f"now {human_format(tokens)}")
+    return state, restored, tokens
 
 
 def main(argv=None) -> None:
@@ -183,6 +237,23 @@ def main(argv=None) -> None:
         total_steps = min(total_steps,
                           start_step + -(-remaining // cfg.tokens_per_step))
 
+    # Runtime resilience (picotron_tpu/resilience; README "Fault
+    # tolerance"). Chaos installs LAST so the eval batches materialized
+    # above cannot consume a data event meant for the training stream.
+    rcfg = cfg.resilience
+    ctrl = chaos.install(rcfg.chaos)
+    if ctrl.active:
+        log_print(f"chaos: {ctrl.describe()}")
+    # The poisoned twin compiles lazily on first use; built only when the
+    # chaos spec names a nan_grad event (injection must happen inside the
+    # jitted step — see make_train_step).
+    poison_step_fn = (make_train_step(cfg, menv, inject_nan=True)
+                      if ctrl.has_nan_grad() else None)
+    guard = (DivergenceGuard.from_config(rcfg)
+             if rcfg.guard_policy != "off" else None)
+    preempt = PreemptionHandler()
+    watchdog = Watchdog(rcfg.watchdog_timeout)
+
     timer = StepTimer()
     last_logged_step = start_step
     # Steps whose checkpoint already exists in the SAVE directory: the loaded
@@ -196,73 +267,176 @@ def main(argv=None) -> None:
     saved_steps = {start_step} if resumed_in_place else set()
     prof = cfg.logging  # trace capture window (config.py LoggingConfig)
     tracing = False
-    for step in range(start_step + 1, total_steps + 1):
-        if prof.profile_dir and step - start_step == prof.profile_start_step:
-            jax.profiler.start_trace(prof.profile_dir)
-            tracing = True
-        batch = next(dl)
-        state, metrics = step_fn(state, batch)
-        trained_tokens += cfg.tokens_per_step
-        if (tracing and step - start_step
-                >= prof.profile_start_step + prof.profile_num_steps - 1):
-            jax.block_until_ready(metrics)
-            jax.profiler.stop_trace()
-            tracing = False
-            log_print(f"profiler trace -> {prof.profile_dir}")
+    exit_code = None
+    # A while loop, not a range: the rollback path rewinds `step` to the
+    # restored checkpoint and the loop re-trains from there.
+    step = start_step
+    try:
+        preempt.install()
+        while step < total_steps:
+            step += 1
+            chaos.fire("step_begin", step=step)
+            if (prof.profile_dir
+                    and step - start_step == prof.profile_start_step):
+                jax.profiler.start_trace(prof.profile_dir)
+                tracing = True
+            watchdog.beat("data", step)
+            batch = next(dl)
+            watchdog.beat("step", step)
+            use_poison = (poison_step_fn is not None
+                          and ctrl.poison_step(step))
+            state, metrics = (poison_step_fn if use_poison
+                              else step_fn)(state, batch)
+            trained_tokens += cfg.tokens_per_step
+            if not watchdog.started:
+                # Arm only after the first step completes: step 1 includes
+                # XLA compilation, whose duration no sane timeout covers.
+                watchdog.start()
+            if (tracing and step - start_step
+                    >= prof.profile_start_step + prof.profile_num_steps - 1):
+                jax.block_until_ready(metrics)
+                jax.profiler.stop_trace()
+                tracing = False
+                log_print(f"profiler trace -> {prof.profile_dir}")
 
-        if step % cfg.logging.log_frequency == 0 or step == total_steps:
-            metrics = {k: float(v)
-                       for k, v in jax.block_until_ready(metrics).items()}
-            loss = metrics.pop("loss")
-            dt = timer.lap()
-            steps_in_window = step - last_logged_step
-            last_logged_step = step
-            tokens_per_sec = cfg.tokens_per_step * steps_in_window / dt
-            mfu_frac = mfu(tokens_per_sec, cfg.model, t.seq_length,
-                           n_chips, peak)
-            line = training_log_line(
-                step, loss, tokens_per_sec, tokens_per_sec / n_chips,
-                mfu_frac, trained_tokens, device_memory_gb(), extras=metrics)
-            log_print(line)
-            if wandb_run is not None:
-                wandb_run.log({"loss": loss, "tokens_per_sec": tokens_per_sec,
-                               "mfu": mfu_frac,
-                               "trained_tokens": trained_tokens, **metrics},
-                              step=step)
+            want_log = (step % cfg.logging.log_frequency == 0
+                        or step == total_steps)
+            fmetrics = None
+            if guard is not None or want_log:
+                watchdog.beat("sync", step)
+                fmetrics = {k: float(v) for k, v in
+                            jax.block_until_ready(metrics).items()}
+            if guard is not None:
+                action, why = guard.observe(
+                    step, fmetrics["loss"],
+                    grad_norm=fmetrics.get("grad_norm"),
+                    nonfinite=fmetrics.get("nonfinite"))
+                if action is GuardAction.ABORT:
+                    log_print(f"[guard {step:06d}] {why}; aborting "
+                              f"(exit {EXIT_DIVERGED})")
+                    exit_code = EXIT_DIVERGED
+                    break
+                if action is GuardAction.SKIP:
+                    if "spike" in why:
+                        # Spikes are detected host-side AFTER the update
+                        # applied; under 'skip' they can only be
+                        # quarantined from the guard window.
+                        log_print(f"[guard {step:06d}] {why}; quarantined "
+                                  f"from the spike window (update already "
+                                  f"applied — policy 'rollback' undoes it)")
+                    else:
+                        log_print(f"[guard {step:06d}] {why}; batch skipped "
+                                  f"(update suppressed in-step, optimizer "
+                                  f"state preserved)")
+                elif action is GuardAction.ROLLBACK:
+                    watchdog.beat("rollback", step)
+                    state, step, trained_tokens = _rollback(
+                        ckpt_mgr, state, dl, step, trained_tokens, why)
+                    saved_steps.add(step)
+                    last_logged_step = step
+                    timer.lap()  # restart the throughput window
+                    continue
 
-        if eval_fn is not None and (step % t.eval_frequency == 0
-                                    or step == total_steps):
-            val = sum(float(eval_fn(state.params, b))
-                      for b in eval_batches) / len(eval_batches)
-            log_print(f"[eval  {step:06d}] val_loss: {val:.4f} "
-                      f"({t.eval_steps} batches)")
-            if wandb_run is not None:
-                wandb_run.log({"val_loss": val}, step=step)
+            if want_log:
+                loss = fmetrics.pop("loss")
+                fmetrics.pop("nonfinite", None)  # guard plumbing, not a metric
+                # Floor the wall-clock window: a ~0 s lap (resume-heavy
+                # tests, clock quantization) must never print inf
+                # tokens/s or inf MFU (mirrors PR 1's decode-timing guard).
+                dt = max(timer.lap(), 1e-9)
+                steps_in_window = step - last_logged_step
+                last_logged_step = step
+                tokens_per_sec = cfg.tokens_per_step * steps_in_window / dt
+                mfu_frac = mfu(tokens_per_sec, cfg.model, t.seq_length,
+                               n_chips, peak)
+                line = training_log_line(
+                    step, loss, tokens_per_sec, tokens_per_sec / n_chips,
+                    mfu_frac, trained_tokens, device_memory_gb(),
+                    extras=fmetrics)
+                log_print(line)
+                if wandb_run is not None:
+                    wandb_run.log({"loss": loss,
+                                   "tokens_per_sec": tokens_per_sec,
+                                   "mfu": mfu_frac,
+                                   "trained_tokens": trained_tokens,
+                                   **fmetrics},
+                                  step=step)
 
-        if ckpt_mgr is not None and step % cfg.checkpoint.save_frequency == 0:
-            path = ckpt_mgr.save(state, trained_tokens,
-                                 dataloader_state=dl.state)
-            saved_steps.add(step)
-            log_print(f"saved checkpoint -> {path}")
+            if eval_fn is not None and (step % t.eval_frequency == 0
+                                        or step == total_steps):
+                watchdog.beat("eval", step)
+                # max(1, ...) guards the division alongside config.py's
+                # eval_steps >= 1 validation (defense in depth: a custom
+                # driver could hand-build a Config bypassing validate()).
+                val = sum(float(eval_fn(state.params, b))
+                          for b in eval_batches) / max(1, len(eval_batches))
+                log_print(f"[eval  {step:06d}] val_loss: {val:.4f} "
+                          f"({t.eval_steps} batches)")
+                if wandb_run is not None:
+                    wandb_run.log({"val_loss": val}, step=step)
 
-    if tracing:  # run ended inside the capture window — close cleanly
-        jax.profiler.stop_trace()
-        log_print(f"profiler trace -> {prof.profile_dir}")
+            if (ckpt_mgr is not None
+                    and step % cfg.checkpoint.save_frequency == 0):
+                watchdog.beat("save", step)
+                path = ckpt_mgr.save(state, trained_tokens,
+                                     dataloader_state=dl.state)
+                saved_steps.add(step)
+                log_print(f"saved checkpoint -> {path}")
 
-    # Final save, unless this run already wrote this exact step (a resumed
-    # run whose budget was met trains zero steps; re-saving the loaded step
-    # into its existing directory would make Orbax fail an otherwise-clean
-    # exit). Tracked in-process so a stale same-numbered checkpoint from an
-    # earlier run into the same save_dir cannot suppress the save.
-    if ckpt_mgr is not None and int(state.step) not in saved_steps:
-        ckpt_mgr.save(state, trained_tokens, dataloader_state=dl.state)
-    if ckpt_mgr is not None:
-        # Async saves overlap training; the process must not exit before
-        # the last one is durable.
-        ckpt_mgr.wait_until_finished()
-    dl.close()
-    if wandb_run is not None:
-        wandb_run.finish()
+            if preempt.triggered:
+                # The in-flight step finished above; make it durable and
+                # hand control back to the supervisor with the distinct
+                # exit code auto_resume pairs with.
+                watchdog.beat("preempt-save", step)
+                ckpt_mgr = _emergency_checkpoint(
+                    cfg, menv, ckpt_mgr, state, trained_tokens, dl,
+                    saved_steps)
+                log_print(f"preempted at step {step}; state is durable — "
+                          f"exiting {EXIT_PREEMPTED} for auto_resume")
+                exit_code = EXIT_PREEMPTED
+                break
+
+        if exit_code is None:
+            # Final save, unless this run already wrote this exact step (a
+            # resumed run whose budget was met trains zero steps; re-saving
+            # the loaded step into its existing directory would make Orbax
+            # fail an otherwise-clean exit). Tracked in-process so a stale
+            # same-numbered checkpoint from an earlier run into the same
+            # save_dir cannot suppress the save.
+            if ckpt_mgr is not None and int(state.step) not in saved_steps:
+                ckpt_mgr.save(state, trained_tokens, dataloader_state=dl.state)
+    finally:
+        # Always-run teardown: a mid-run crash must not leak the producer
+        # thread, a half-written async checkpoint, an open trace, or a
+        # dangling wandb run. Each step is fenced so one failing cleanup
+        # cannot mask the original exception (or the other cleanups).
+        watchdog.stop()
+        preempt.uninstall()
+        if tracing:
+            try:
+                jax.profiler.stop_trace()
+                log_print(f"profiler trace -> {prof.profile_dir}")
+            except Exception as e:  # noqa: BLE001
+                log_print(f"profiler stop failed during shutdown: {e!r}")
+        if ckpt_mgr is not None:
+            # Async saves overlap training; the process must not exit
+            # before the last one is durable.
+            try:
+                ckpt_mgr.wait_until_finished()
+            except Exception as e:  # noqa: BLE001
+                log_print(f"checkpoint finalization failed during "
+                          f"shutdown: {e!r}")
+        try:
+            dl.close()
+        except Exception as e:  # noqa: BLE001
+            log_print(f"dataloader close failed during shutdown: {e!r}")
+        if wandb_run is not None:
+            try:
+                wandb_run.finish()
+            except Exception as e:  # noqa: BLE001
+                log_print(f"wandb finish failed during shutdown: {e!r}")
+    if exit_code is not None:
+        raise SystemExit(exit_code)
     log_print("training done")
 
 

@@ -34,6 +34,18 @@ def init_train_state(cfg: Config, params) -> TrainState:
                       step=jnp.zeros((), jnp.int32))
 
 
+def guard_nonfinite(ok, new_tree, old_tree):
+    """In-jit half of the divergence guard's 'skip' policy: when `ok` (a
+    scalar bool — loss and grad norm both finite) is False, keep every
+    `old_tree` leaf, discarding the poisoned update while preserving
+    optimizer state. Must run inside the step — with donated input
+    buffers the host cannot resurrect the pre-step state after the fact.
+    The step counter still advances, so schedules and token budgets are
+    unaffected by a dropped batch."""
+    return jax.tree.map(lambda n, o: jnp.where(ok, n, o),
+                        new_tree, old_tree)
+
+
 def accumulate_grads(params, batch, cfg: Config, ctx: ParallelCtx):
     """Scan microbatches, accumulating fp32 grads and the mean loss.
 

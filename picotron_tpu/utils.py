@@ -9,7 +9,9 @@ generation from the device kind, as SURVEY.md §5 prescribes.
 from __future__ import annotations
 
 import sys
+import threading
 import time
+import traceback
 from typing import Optional
 
 import jax
@@ -147,6 +149,20 @@ def training_log_line(step: int, loss: float, tokens_per_sec: float,
     for k, v in (extras or {}).items():
         line += f" | {k}: {v:.4f}"
     return line
+
+
+def dump_all_stacks(file=None) -> None:
+    """Write every thread's Python stack to `file` (default stderr) — the
+    watchdog's post-mortem when a step or the data producer hangs: which
+    thread is stuck, and where. Thread names come from threading;
+    sys._current_frames also surfaces threads the module does not know."""
+    file = file or sys.stderr
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for ident, frame in sys._current_frames().items():
+        print(f"--- thread {names.get(ident, '<unknown>')} "
+              f"(ident {ident}) ---", file=file)
+        traceback.print_stack(frame, file=file)
+    file.flush()
 
 
 def device_memory_gb() -> float:

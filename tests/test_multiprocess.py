@@ -43,7 +43,8 @@ def _free_port() -> int:
 
 
 def _write_cfg(tmp_path, distributed, model="debug-tiny",
-               attn_impl=None, dataset=None):
+               attn_impl=None, dataset=None, name="cfg.json",
+               overrides=None):
     cfg = {
         "distributed": {"use_cpu": True, **distributed},
         "model": {"name": model, "dtype": "float32",
@@ -56,12 +57,14 @@ def _write_cfg(tmp_path, distributed, model="debug-tiny",
         "checkpoint": {"save_dir": str(tmp_path / "ckpt")},
         "logging": {"log_frequency": 1},
     }
-    path = tmp_path / "cfg.json"
+    for section, vals in (overrides or {}).items():
+        cfg.setdefault(section, {}).update(vals)
+    path = tmp_path / name
     path.write_text(json.dumps(cfg))
     return str(path)
 
 
-def _launch(cfg_path, n_proc, port):
+def _launch(cfg_path, n_proc, port, extra_env=None):
     """Spawn the trainer CLI in n_proc coordinated processes; return the
     list of Popen handles."""
     procs = []
@@ -71,6 +74,7 @@ def _launch(cfg_path, n_proc, port):
         # must set the per-process count itself (the inherited pytest flag
         # would give every process the full 8 and break the world math).
         env.pop("XLA_FLAGS", None)
+        env.update(extra_env or {})
         env.update({
             "PICOTRON_COORDINATOR": f"127.0.0.1:{port}",
             "PICOTRON_NUM_PROCESSES": str(n_proc),
@@ -176,6 +180,66 @@ def test_loader_callback_path_matches_device_put(monkeypatch):
     for sa, sb in zip(ids_a.addressable_shards, ids_b.addressable_shards):
         assert sa.device == sb.device
         np.testing.assert_array_equal(np.asarray(sa.data), np.asarray(sb.data))
+
+
+def _communicate(procs):
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            p.kill()
+    return outs
+
+
+def test_two_process_sigterm_emergency_resume(tmp_path):
+    """Kill-and-resume across the real 2-process gloo runtime: chaos
+    delivers SIGTERM to BOTH processes at the same step (the way a pod
+    preemption hits every host), the coordinated emergency save must be
+    durable, both processes must exit EXIT_PREEMPTED, and the auto_resume
+    relaunch must continue to a loss curve matching an uninterrupted
+    single-process run — dp spans the process boundary, so the emergency
+    checkpoint's optimizer state crossed gloo both ways."""
+    from picotron_tpu.resilience import EXIT_PREEMPTED
+
+    total = 5
+    layout = {"dp_size": 2, "tp_size": 2}
+    # uninterrupted single-process reference, separate save_dir
+    ref_cfg = _write_cfg(
+        tmp_path, layout, name="ref.json",
+        overrides={"training": {"total_train_steps": total},
+                   "checkpoint": {"save_dir": str(tmp_path / "ckpt_ref")}})
+    single = _run_single(ref_cfg)
+    assert len(single) == total
+
+    cfg_path = _write_cfg(
+        tmp_path, layout,
+        overrides={"training": {"total_train_steps": total},
+                   "checkpoint": {"save_frequency": 0,
+                                  "auto_resume": True},
+                   "resilience": {"chaos": "sigterm@2"}})
+    outs = _communicate(_launch(cfg_path, n_proc=2, port=_free_port()))
+    for rc, _, err in outs:
+        assert rc == EXIT_PREEMPTED, f"expected exit 75, got {rc}:\n{err[-3000:]}"
+    assert "emergency checkpoint" in outs[0][1]
+    meta = json.loads((tmp_path / "ckpt" / "step_00000002" /
+                       "meta.json").read_text())
+    assert meta["step"] == 2 and meta["dataloader"] == {"epoch": 0,
+                                                        "cursor": 16}
+
+    # supervisor resubmission: same config, chaos disabled via the env
+    # override, auto_resume picks up the emergency checkpoint
+    outs2 = _communicate(_launch(cfg_path, n_proc=2, port=_free_port(),
+                                 extra_env={"PICOTRON_CHAOS": ""}))
+    for rc, _, err in outs2:
+        assert rc == 0, f"resumed run failed:\n{err[-3000:]}"
+    assert "auto_resume: found checkpoints" in outs2[0][1]
+    assert "training done" in outs2[0][1]
+    stitched = _losses(outs[0][1]) + _losses(outs2[0][1])
+    assert len(stitched) == total
+    np.testing.assert_allclose(stitched, single, rtol=1e-5, atol=1e-6)
 
 
 def _build_disk_corpus(path, blocks=256, seq=32, vocab=256, seed=11):

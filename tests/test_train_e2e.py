@@ -149,6 +149,92 @@ def test_trainer_max_tokens_stops_early(tmp_path, capsys):
     assert [int(r["step"]) for r in rows][-1] == 3
 
 
+def test_trainer_chaos_sigterm_emergency_ckpt_and_lossless_resume(
+        tmp_path, capsys):
+    """Kill-and-resume, in-process: chaos delivers a real SIGTERM at step
+    2; the trainer must finish the in-flight step, write a durable
+    emergency checkpoint (dataloader cursor included) even though periodic
+    saving is OFF, and exit EXIT_PREEMPTED. The auto_resume rerun must
+    continue at step 3 without replaying data, and the combined loss curve
+    must equal an uninterrupted run's exactly."""
+    from picotron_tpu.resilience import EXIT_PREEMPTED
+
+    cfg = write_cfg(
+        tmp_path,
+        training={"total_train_steps": 5},
+        checkpoint={"save_frequency": 0, "auto_resume": True},
+        resilience={"chaos": "sigterm@2"})
+    with pytest.raises(SystemExit) as exc:
+        train.main(["--config", cfg])
+    assert exc.value.code == EXIT_PREEMPTED
+    out1 = capsys.readouterr().out
+    assert "emergency checkpoint" in out1
+    rows1 = [m.groupdict() for line in out1.splitlines()
+             if (m := LINE_RE.search(line))]
+    assert [int(r["step"]) for r in rows1] == [1, 2]  # in-flight step done
+
+    meta = json.loads((tmp_path / "ckpt" / "step_00000002" /
+                       "meta.json").read_text())
+    assert meta["step"] == 2
+    assert meta["trained_tokens"] == 2 * 8 * 32
+    assert meta["dataloader"] == {"epoch": 0, "cursor": 16}
+
+    # resubmission: same config, chaos spun down (the env override a real
+    # supervisor would use)
+    import os as _os
+    _os.environ["PICOTRON_CHAOS"] = ""
+    try:
+        out2 = run_main(cfg, capsys)
+    finally:
+        _os.environ.pop("PICOTRON_CHAOS", None)
+    assert "auto_resume: found checkpoints" in out2
+    rows2 = [m.groupdict() for line in out2.splitlines()
+             if (m := LINE_RE.search(line))]
+    assert [int(r["step"]) for r in rows2] == [3, 4, 5]
+
+    # no data replay, no lost state: the stitched curve equals an
+    # uninterrupted run of the same config bit-for-bit
+    base = write_cfg(tmp_path, name="base.json",
+                     training={"total_train_steps": 5},
+                     checkpoint={"save_dir": str(tmp_path / "ckpt_base")})
+    out_base = run_main(base, capsys)
+    base_losses = [float(m.group("loss")) for line in out_base.splitlines()
+                   if (m := LINE_RE.search(line))]
+    stitched = [float(r["loss"]) for r in rows1 + rows2]
+    np.testing.assert_allclose(stitched, base_losses, rtol=1e-6)
+
+
+def test_trainer_nan_guard_skip_policy_completes(tmp_path, capsys):
+    """nan_grad chaos under guard_policy=skip: the poisoned step is
+    dropped in-jit, the run completes to the full budget, and the final
+    step/token accounting matches a fault-free run."""
+    cfg = write_cfg(
+        tmp_path,
+        training={"total_train_steps": 5},
+        checkpoint={"save_frequency": 5},
+        resilience={"chaos": "nan_grad@3", "guard_policy": "skip"})
+    out = run_main(cfg, capsys)
+    assert "batch skipped" in out
+    assert "training done" in out
+    meta = json.loads((tmp_path / "ckpt" / "step_00000005" /
+                       "meta.json").read_text())
+    assert meta["step"] == 5
+    assert meta["trained_tokens"] == 5 * 8 * 32
+
+
+def test_trainer_nan_guard_abort_exits_distinctly(tmp_path, capsys):
+    from picotron_tpu.resilience import EXIT_DIVERGED
+
+    cfg = write_cfg(
+        tmp_path,
+        training={"total_train_steps": 5},
+        resilience={"chaos": "nan_grad@2", "guard_policy": "abort"})
+    with pytest.raises(SystemExit) as exc:
+        train.main(["--config", cfg])
+    assert exc.value.code == EXIT_DIVERGED
+    assert "aborting" in capsys.readouterr().out
+
+
 def test_trainer_prefetch_matches_sync(tmp_path, capsys):
     """num_workers > 0 (background prefetch thread) must not change the
     training stream."""

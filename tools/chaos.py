@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""Does the trainer actually survive the failure you fear? — scenario runs.
+
+Each named scenario runs a short CPU training job under a chaos spec
+(picotron_tpu/resilience/chaos.py), plays external supervisor (restart on
+the resilience exit codes, with the fault disabled on restart — the way a
+real resubmission does not re-live a preemption), and verifies recovery:
+the run must reach EXIT 0 within the restart budget, its log must show the
+resilience mechanism actually engaged, and the final checkpoint's step and
+trained_tokens must MATCH a fault-free baseline run of the same config —
+i.e. the failure cost retries/restarts, not training progress.
+
+Scenarios (the runtime-failure matrix README "Fault tolerance" documents):
+
+  sigterm       preemption mid-run -> emergency ckpt + exit 75 -> resume
+  ckpt_io       transient checkpoint-write I/O errors -> absorbed by retry
+  nan_skip      NaN gradients, guard_policy=skip -> batch dropped in-step
+  nan_rollback  NaN gradients, guard_policy=rollback -> restore + skip data
+  data_stall    stuck data producer -> watchdog exit 77 -> resume
+
+Usage:
+
+  python tools/chaos.py --list
+  python tools/chaos.py --scenario sigterm
+  python tools/chaos.py --all          # exit 0 iff every scenario recovers
+
+Long by design (each scenario is several full trainer subprocesses);
+the test tier marks these `slow`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+from dataclasses import dataclass, field
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from picotron_tpu.resilience import (  # noqa: E402
+    EXIT_PREEMPTED, EXIT_WATCHDOG,
+)
+
+STEPS = 6  # total_train_steps for every scenario (fault lands mid-run)
+
+
+@dataclass
+class Scenario:
+    chaos: str                      # resilience.chaos spec for the first run
+    marker: str                     # log regex proving the mechanism engaged
+    note: str                       # one-line human description
+    expect_exits: tuple = ()        # nonzero exits the supervisor restarts on
+    max_restarts: int = 0           # restart budget (0 = must recover in-run)
+    overrides: dict = field(default_factory=dict)  # config section updates
+
+
+SCENARIOS: dict[str, Scenario] = {
+    "sigterm": Scenario(
+        chaos=f"sigterm@{STEPS // 2}",
+        expect_exits=(EXIT_PREEMPTED,),
+        max_restarts=2,
+        marker=r"emergency checkpoint ->",
+        note="preemption mid-run: finish step, emergency ckpt, exit "
+             f"{EXIT_PREEMPTED}, auto_resume",
+    ),
+    "ckpt_io": Scenario(
+        # Two injected write failures at the step-2 save; the default
+        # 3-attempt retry absorbs them with no restart.
+        chaos="ckpt_io@2x2",
+        marker=r"\[retry\] checkpoint save",
+        note="transient checkpoint-write I/O errors absorbed by "
+             "retry-with-backoff",
+    ),
+    "nan_skip": Scenario(
+        chaos=f"nan_grad@{STEPS // 2}",
+        overrides={"resilience": {"guard_policy": "skip"}},
+        marker=r"batch skipped",
+        note="NaN gradients dropped in-step (optimizer state preserved), "
+             "run continues",
+    ),
+    "nan_rollback": Scenario(
+        chaos=f"nan_grad@{STEPS - 2}",
+        overrides={"resilience": {"guard_policy": "rollback"}},
+        marker=r"rolled back to step",
+        note="NaN gradients: restore last durable ckpt, skip the poison "
+             "data range, re-train",
+    ),
+    "data_stall": Scenario(
+        # Producer sleeps far longer than the watchdog timeout; the
+        # watchdog dumps stacks and exits for the supervisor to restart.
+        chaos=f"data_stall@{STEPS // 2}~120",
+        expect_exits=(EXIT_WATCHDOG,),
+        max_restarts=2,
+        overrides={"dataset": {"num_workers": 2},
+                   "resilience": {"watchdog_timeout": 5.0}},
+        marker=r"\[watchdog\] no progress",
+        note="stalled data producer: watchdog stack-dump + exit "
+             f"{EXIT_WATCHDOG}, supervisor restart, auto_resume",
+    ),
+}
+
+
+def scenario_config(workdir: str, chaos_spec: str,
+                    overrides: dict) -> dict:
+    cfg = {
+        "distributed": {"dp_size": 2, "tp_size": 2, "use_cpu": True},
+        "model": {"name": "debug-tiny", "dtype": "float32"},
+        "training": {"total_train_steps": STEPS, "seq_length": 32,
+                     "micro_batch_size": 2,
+                     "gradient_accumulation_steps": 1,
+                     "remat": False, "seed": 5},
+        "dataset": {"name": "synthetic", "num_workers": 0},
+        "checkpoint": {"save_dir": os.path.join(workdir, "ckpt"),
+                       "save_frequency": 2, "auto_resume": True},
+        "logging": {"log_frequency": 1},
+        "resilience": {"chaos": chaos_spec,
+                       "retry_base_delay": 0.05, "retry_max_delay": 0.2},
+    }
+    for section, vals in overrides.items():
+        cfg.setdefault(section, {}).update(vals)
+    return cfg
+
+
+def _run_trainer(cfg_path: str, log_path: str, extra_env: dict) -> int:
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # trainer provisions its own device count
+    for k in ("PICOTRON_COORDINATOR", "PICOTRON_NUM_PROCESSES",
+              "PICOTRON_PROCESS_ID"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PICOTRON_PREFLIGHT"] = "0"  # scenario wall-time, not shardcheck's
+    env.update(extra_env)
+    with open(log_path, "ab") as log:
+        return subprocess.run(
+            [sys.executable, "-m", "picotron_tpu.train",
+             "--config", cfg_path],
+            stdout=log, stderr=subprocess.STDOUT, env=env,
+            timeout=600).returncode
+
+
+def _final_meta(save_dir: str) -> dict:
+    """meta.json of the newest step dir that has a committed state dir.
+    The runs verified here exited 0, so the last save is finalized."""
+    steps = sorted(
+        int(m.group(1)) for d in os.listdir(save_dir)
+        if (m := re.fullmatch(r"step_(\d+)", d))
+        and os.path.isdir(os.path.join(save_dir, d, "state")))
+    if not steps:
+        raise FileNotFoundError(f"no checkpoints under {save_dir}")
+    with open(os.path.join(save_dir, f"step_{steps[-1]:08d}",
+                           "meta.json")) as f:
+        return json.load(f)
+
+
+def run_scenario(name: str, workdir: str, verbose: bool = False) -> bool:
+    sc = SCENARIOS[name]
+    fail = lambda msg: (print(f"[chaos-cli] {name}: FAIL — {msg}"),  # noqa: E731
+                        False)[1]
+
+    # Fault-free baseline: what "no training progress lost" means.
+    base_dir = os.path.join(workdir, "baseline")
+    os.makedirs(base_dir, exist_ok=True)
+    base_cfg = scenario_config(base_dir, "", sc.overrides)
+    base_path = os.path.join(base_dir, "config.json")
+    with open(base_path, "w") as f:
+        json.dump(base_cfg, f)
+    rc = _run_trainer(base_path, os.path.join(base_dir, "run.log"), {})
+    if rc != 0:
+        return fail(f"baseline run exited {rc}")
+    base_meta = _final_meta(base_cfg["checkpoint"]["save_dir"])
+
+    # Fault run under supervision.
+    fault_dir = os.path.join(workdir, "fault")
+    os.makedirs(fault_dir, exist_ok=True)
+    cfg = scenario_config(fault_dir, sc.chaos, sc.overrides)
+    cfg_path = os.path.join(fault_dir, "config.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    log_path = os.path.join(fault_dir, "run.log")
+    exits = []
+    for attempt in range(sc.max_restarts + 1):
+        # Restarts disable injection via the env override — a resubmitted
+        # job does not re-live the environmental fault.
+        extra = {} if attempt == 0 else {"PICOTRON_CHAOS": ""}
+        rc = _run_trainer(cfg_path, log_path, extra)
+        exits.append(rc)
+        if rc == 0:
+            break
+        if rc not in sc.expect_exits:
+            return fail(f"unexpected exit {rc} (allowed: 0 or "
+                        f"{sc.expect_exits}); exits so far {exits}")
+    if exits[-1] != 0:
+        return fail(f"did not recover within {sc.max_restarts} restarts "
+                    f"(exits {exits})")
+
+    with open(log_path) as f:
+        log_text = f.read()
+    if verbose:
+        print(log_text)
+    if not re.search(sc.marker, log_text):
+        return fail(f"recovery marker /{sc.marker}/ absent from {log_path}")
+    meta = _final_meta(cfg["checkpoint"]["save_dir"])
+    for key in ("step", "trained_tokens"):
+        if meta[key] != base_meta[key]:
+            return fail(f"final {key} {meta[key]} != fault-free baseline "
+                        f"{base_meta[key]}")
+    print(f"[chaos-cli] {name}: OK — exits {exits}, final step "
+          f"{meta['step']} / {meta['trained_tokens']} tokens match "
+          f"baseline")
+    return True
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        description="picotron-tpu fault-recovery scenario runner")
+    ap.add_argument("--scenario", action="append", default=[],
+                    choices=sorted(SCENARIOS),
+                    help="scenario to run (repeatable)")
+    ap.add_argument("--all", action="store_true",
+                    help="run every scenario")
+    ap.add_argument("--list", action="store_true",
+                    help="list scenarios and exit")
+    ap.add_argument("--workdir", default=None,
+                    help="scratch directory (default: a fresh tempdir)")
+    ap.add_argument("--verbose", action="store_true",
+                    help="print the fault run's log")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list:
+        for name, sc in SCENARIOS.items():
+            print(f"{name:14s} chaos={sc.chaos!r:24s} {sc.note}")
+        return 0
+    names = sorted(set(args.scenario)) if args.scenario else []
+    if args.all:
+        names = sorted(SCENARIOS)
+    if not names:
+        build_parser().error("pick --scenario NAME (repeatable), --all, "
+                             "or --list")
+    workdir = args.workdir
+    if workdir is None:
+        import tempfile
+
+        workdir = tempfile.mkdtemp(prefix="picotron-chaos-")
+    ok = True
+    for name in names:
+        sub = os.path.join(workdir, name)
+        os.makedirs(sub, exist_ok=True)
+        ok &= run_scenario(name, sub, verbose=args.verbose)
+    print(f"[chaos-cli] {'all scenarios recovered' if ok else 'FAILURES'} "
+          f"(workdir {workdir})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
