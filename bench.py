@@ -103,7 +103,8 @@ def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
             steps: int = 8, warmup: int = 2, remat: bool = True,
             remat_policy: str = "dots", adam_moments_dtype: str = "bfloat16",
             ce_chunk: int = 0, optimizer_offload: bool = False,
-            profile: str | None = None) -> dict:
+            profile: str | None = None,
+            profile_steps: int | None = None) -> dict:
     from picotron_tpu.mesh import MeshEnv
     from picotron_tpu.parallel.api import init_sharded_state, make_train_step
     from picotron_tpu.utils import device_peak_flops, flops_per_token, mfu
@@ -140,10 +141,18 @@ def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
     # ~100ms/step over a remote-tunnel backend). block_until_ready is NOT
     # trustworthy here — with donated (aliased) state buffers it can return
     # before the execution chain has run; a value fetch cannot lie.
-    if profile:
+    # --profile-steps N caps the capture window to the LAST N timed steps:
+    # a full-window capture at the mbs-3 headline OOMs the chip (the fused
+    # scan's in-flight slices + xprof's device trace buffers, PERF.md r5);
+    # a single-step window is the documented capture config that fits.
+    cap = steps if profile_steps is None else min(profile_steps, steps)
+    if profile and cap >= steps:
         jax.profiler.start_trace(profile)
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for i in range(steps):
+        if profile and cap < steps and i == steps - cap:
+            float(metrics["loss"])  # drain the chain before the window
+            jax.profiler.start_trace(profile)
         state, metrics = step(state, batch)
     final_loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
@@ -176,14 +185,21 @@ def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
 
 
 def run_decode(model: str, layers, prompt_len: int, max_new: int,
-               batch: int, steps: int = 3) -> dict:
+               batch: int, steps: int = 3, tp: int = 1) -> dict:
     """Generation throughput on the chip (the reference is training-only,
     ref: README.md:2 — this is the beyond-parity feature's number): one
     JSON line with steady-state decode tokens/s as the headline value plus
     the prefill rate. The prefill/decode split comes from differencing a
     max_new=1 run (prefill + one sample) against the full run — the two
     phases live inside one jitted program, so there is no boundary to
-    time directly."""
+    time directly.
+
+    tp > 1 re-places the params into the training TP shardings over tp
+    chips (`place_for_decode`; pure GSPMD decode, XLA shards the KV cache
+    and inserts the collectives) — the 7B-scale decode arrangement. The
+    Llama-2-7B per-layer decode anchor is the 4L proxy:
+    `bench.py --decode --model Llama-2-7B --layers 4` (single chip bf16;
+    add --tp 2 on a multi-chip host for the sharded path)."""
     import numpy as np
 
     from picotron_tpu.config import ModelConfig, resolve_preset
@@ -199,7 +215,7 @@ def run_decode(model: str, layers, prompt_len: int, max_new: int,
     params = jax.jit(
         lambda k: jax.tree.map(lambda x: x.astype(jnp.bfloat16),
                                init_params(mcfg, k)))(jax.random.key(0))
-    params = place_for_decode(params, mcfg)
+    params = place_for_decode(params, mcfg, tp=tp)
     prompts = jax.random.randint(jax.random.key(1), (batch, prompt_len),
                                  0, mcfg.vocab_size)
 
@@ -231,9 +247,11 @@ def run_decode(model: str, layers, prompt_len: int, max_new: int,
             f"decode rate")
     dt = max(t_full - t_prefill, 1e-9)
     decode_tps = batch * (max_new - 1) / dt
+    tp_tag = f"-tp{tp}" if tp > 1 else ""
     return {
         "metric": f"decode_{model.split('/')[-1]}"
-                  f"-{mcfg.num_hidden_layers}L",
+                  f"-{mcfg.num_hidden_layers}L{tp_tag}",
+        "tp": tp,
         "value": round(decode_tps, 1),
         "unit": "decode_tokens_per_sec",
         "prefill_tokens_per_sec": round(batch * prompt_len / t_prefill, 1),
@@ -243,6 +261,97 @@ def run_decode(model: str, layers, prompt_len: int, max_new: int,
         "decode_ms_per_token_per_seq": round(dt / (max_new - 1) * 1e3, 2),
         "device_kind": jax.devices()[0].device_kind,
     }
+
+
+def run_bwd_grid_sweep(model: str, seq: int, batch: int, steps: int = 5,
+                       blocks=None) -> list:
+    """Block-size sweep of the flash attention KERNEL PAIR (fwd, fwd+bwd)
+    at long sequence — the instrument for VERDICT r5 next #8: at seq 16k
+    the bwd pair runs ~2.3x the fwd wall but the pair sits below the
+    causal roofline, and PERF.md r5 attributes the excess to *pipeline
+    overhead* (program count x per-program ramp), which is exactly what
+    the (block_q, block_k) grid shape controls. One JSON line per combo
+    with the pair's achieved TFLOP/s and fraction of the device's causal
+    attention roofline; combos whose fp32 [BQ, BK] score block exceeds
+    VMEM report their compile error instead of silently vanishing.
+
+    Run on hardware: `python bench.py --bwd-grid-sweep --seq 16384`.
+    The jnp fallback makes CPU runs structural smoke only.
+    """
+    from picotron_tpu.config import resolve_preset
+    from picotron_tpu.ops.flash_attention import flash_attention
+    from picotron_tpu.utils import device_peak_flops
+
+    preset = resolve_preset(model)
+    hq = preset["num_attention_heads"]
+    hkv = preset["num_key_value_heads"]
+    d = preset["hidden_size"] // hq
+    on_tpu = jax.devices()[0].platform == "tpu"
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    ks = jax.random.split(jax.random.key(0), 4)
+    q = jax.random.normal(ks[0], (batch, seq, hq, d), dt)
+    k = jax.random.normal(ks[1], (batch, seq, hkv, d), dt)
+    v = jax.random.normal(ks[2], (batch, seq, hkv, d), dt)
+    do = jax.random.normal(ks[3], (batch, seq, hq, d), dt)
+
+    # causal attention flops: qk + pv matmuls over the lower triangle
+    # (2 * 2 * B*H*S^2*D / 2); backward re-derives s/p and runs the three
+    # grad matmuls -> 2.5x the forward's matmul flops
+    fwd_flops = 2 * batch * hq * seq * seq * d
+    pair_flops = fwd_flops * 3.5
+    peak = device_peak_flops()
+
+    def timed(fn, *args) -> float:
+        fn(*args).block_until_ready()  # compile
+        best = float("inf")
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            float(fn(*args))  # value fetch: the chain must have executed
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    blocks = blocks or [(256, 256), (512, 512), (512, 1024), (1024, 512),
+                        (1024, 1024), (1024, 2048), (2048, 1024),
+                        (2048, 2048)]
+    rows = []
+    for bq, bk in blocks:
+        row = {"metric": f"bwd_grid_{model.split('/')[-1]}_seq{seq}",
+               "block_q": bq, "block_k": bk, "seq": seq, "batch": batch,
+               "unit": "pair_fraction_of_peak",
+               "device_kind": jax.devices()[0].device_kind,
+               "is_tpu_kernel": on_tpu}
+        try:
+            def fwd(q, k, v, bq=bq, bk=bk):
+                return jnp.sum(flash_attention(
+                    q, k, v, causal=True, block_q=bq, block_k=bk)
+                    .astype(jnp.float32))
+
+            def pair(q, k, v, do, bq=bq, bk=bk):
+                def f(q_, k_, v_):
+                    return flash_attention(q_, k_, v_, causal=True,
+                                           block_q=bq, block_k=bk)
+
+                out, vjp = jax.vjp(f, q, k, v)
+                dq, dk, dv = vjp(do)
+                return (jnp.sum(out.astype(jnp.float32))
+                        + jnp.sum(dq.astype(jnp.float32))
+                        + jnp.sum(dk.astype(jnp.float32))
+                        + jnp.sum(dv.astype(jnp.float32)))
+
+            t_fwd = timed(jax.jit(fwd), q, k, v)
+            t_pair = timed(jax.jit(pair), q, k, v, do)
+            row.update({
+                "fwd_ms": round(t_fwd * 1e3, 3),
+                "pair_ms": round(t_pair * 1e3, 3),
+                "bwd_over_fwd": round((t_pair - t_fwd) / t_fwd, 2),
+                "pair_tflops": round(pair_flops / t_pair / 1e12, 2),
+                "value": round(pair_flops / t_pair / peak, 4),
+            })
+        except Exception as e:  # VMEM-exceeding combos are data, not noise
+            row["error"] = f"{type(e).__name__}: {str(e)[:160]}"
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    return rows
 
 
 def main() -> None:
@@ -291,6 +400,14 @@ def main() -> None:
                          "into DIR (open with xprof/tensorboard; see "
                          "README 'Profiling'). SURVEY.md §5 prescribes "
                          "profiler traces as the TPU observability story.")
+    ap.add_argument("--profile-steps", type=int, default=None,
+                    help="capture only the LAST N timed steps in the "
+                         "--profile trace (default: all). The memory-tight "
+                         "configs need a single-step window: a full-window "
+                         "capture OOMs the mbs-3 offload headline "
+                         "(in-flight fused-scan slices + xprof device "
+                         "buffers; PERF.md). Use `--profile DIR "
+                         "--profile-steps 1`.")
     ap.add_argument("--sweep", action="store_true",
                     help="run the breadth matrix (one JSON line per config, "
                          "headline last) instead of a single config")
@@ -310,11 +427,31 @@ def main() -> None:
                     help="--decode: prefill length")
     ap.add_argument("--max-new-tokens", type=int, default=128,
                     help="--decode: decode steps measured")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="--decode: shard the params (and, via GSPMD, the "
+                         "KV cache) over N chips with the training TP "
+                         "layout (generate.place_for_decode) — the "
+                         "7B-scale decode arrangement")
+    ap.add_argument("--bwd-grid-sweep", action="store_true",
+                    help="sweep flash-attention (block_q, block_k) over "
+                         "the fwd / fwd+bwd kernel pair at --seq (use "
+                         "16384 for the VERDICT r5 #8 question: is the "
+                         "16k bwd-pair excess pipeline overhead the grid "
+                         "shape can shrink?); one JSON line per combo")
     args = ap.parse_args()
 
-    if args.shardcheck and (args.sweep or args.decode or args.profile):
+    if args.shardcheck and (args.sweep or args.decode or args.profile
+                            or args.bwd_grid_sweep):
         ap.error("--shardcheck is its own mode; incompatible with "
-                 "--sweep/--decode/--profile")
+                 "--sweep/--decode/--profile/--bwd-grid-sweep")
+
+    if args.bwd_grid_sweep:
+        if args.sweep or args.decode or args.profile:
+            ap.error("--bwd-grid-sweep is its own mode; incompatible with "
+                     "--sweep/--decode/--profile")
+        run_bwd_grid_sweep(args.model, args.seq, args.mbs or 1,
+                           steps=args.steps)
+        return
 
     if args.decode:
         if args.sweep or args.profile:
@@ -326,7 +463,8 @@ def main() -> None:
             ap.error("--decode needs --max-new-tokens >= 2")
         print(json.dumps(run_decode(
             args.model, args.layers or 0, args.prompt_len,
-            args.max_new_tokens, args.batch, steps=args.steps)))
+            args.max_new_tokens, args.batch, steps=args.steps,
+            tp=args.tp)))
         return
 
     if args.sweep:
@@ -346,6 +484,8 @@ def main() -> None:
                     "remat_policy": (None, "--remat-policy"),
                     "optimizer_offload": (False, "--optimizer-offload"),
                     "profile": (None, "--profile"),
+                    "profile_steps": (None, "--profile-steps"),
+                    "tp": (1, "--tp"),
                     "no_remat": (False, "--no-remat")}
         clashing = [flag for k, (v, flag) in defaults.items()
                     if getattr(args, k) != v]
@@ -472,7 +612,8 @@ def main() -> None:
         steps=args.steps, warmup=args.warmup, remat=not args.no_remat,
         remat_policy=args.remat_policy,
         adam_moments_dtype=args.adam_moments_dtype, ce_chunk=args.ce_chunk,
-        optimizer_offload=args.optimizer_offload, profile=args.profile)))
+        optimizer_offload=args.optimizer_offload, profile=args.profile,
+        profile_steps=args.profile_steps)))
 
 
 if __name__ == "__main__":

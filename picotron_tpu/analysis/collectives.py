@@ -12,7 +12,13 @@ Two rule families:
 - **presence**: the schedule a config promises must exist — a grad-sync
   all-reduce whose replica-group size is dp*ep*cp (the fused data axes),
   a pipeline boundary collective_permute when pp > 1, an expert-dispatch
-  all_to_all when ep > 1.
+  all_to_all when ep > 1, the Megatron-SP all-gather/reduce-scatter pair
+  over tp under sequence_parallel, the K/V-ring collective_permute when
+  cp > 1, and the Ulysses seq<->head all_to_all under attn_impl='ulysses'.
+  These rules are grad-engine-independent: the fused engine's manual
+  backward (parallel/fused_bwd.py) must lower the same per-axis schedule
+  the AD engine's transposes produce, so `grad_engine: fused` configs are
+  audited, not skipped.
 - **budget** (the accidental-replication detector): no all-gather may
   produce an output larger than the configured byte budget. The default
   budget is the largest thing the program legitimately gathers — the
@@ -59,6 +65,22 @@ _RE_HLO_GROUPS = re.compile(r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
 _RE_HLO_IOTA = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
 _RE_HLO_PAIRS = re.compile(r"source_target_pairs=\{([^}]*)\}")
 _RE_HLO_SHAPE = re.compile(r"=\s*([a-z]+[0-9]+|pred)\[([0-9,]*)\]")
+
+
+def resolved_grad_engine(cfg) -> str:
+    """The grad engine the step actually compiles ('fused'/'ad'/'1f1b'),
+    resolving 'auto' exactly like parallel/api.py's _device_grads."""
+    from picotron_tpu.parallel.fused_bwd import fused_bwd_supported
+
+    if cfg.distributed.pp_size > 1:
+        return cfg.distributed.pp_engine
+    t = cfg.training
+    if (t.grad_engine == "fused"
+            or (t.grad_engine == "auto"
+                and t.gradient_accumulation_steps > 1
+                and fused_bwd_supported(cfg))):
+        return "fused"
+    return "ad"
 
 
 @dataclass(frozen=True)
@@ -188,6 +210,14 @@ def audit_collectives(cfg, *, text: str = None, state=None,
         **counts,
         "total_effective": len(eff),
         "compiled_away (size-1 groups)": len(ops) - len(eff),
+        # which grad engine the audited program actually lowered — the
+        # fused engine's manual backward must emit the same per-axis
+        # schedule as the AD engine (SP reduce-scatter/all-gather pair, CP
+        # reverse-ring ppermute, Ulysses all-to-all), so the presence
+        # rules below audit `grad_engine: fused` configs instead of
+        # skipping them; tests/test_shardcheck.py pins the negative case
+        # (a deleted SP reduce-scatter must flag).
+        "grad_engine": resolved_grad_engine(cfg),
     }
 
     # -- presence rules ----------------------------------------------------
@@ -217,6 +247,46 @@ def audit_collectives(cfg, *, text: str = None, state=None,
                 f"ep_size={d.ep_size} with {cfg.model.num_experts} experts "
                 f"but no all_to_all: expert dispatch is not crossing the "
                 f"'ep' axis (tokens only ever reach local experts)")
+
+    # per-axis attention/SP schedule (engine-independent: the AD engine's
+    # transposes and the fused engine's manual backward must both emit
+    # these — a fused config that lost one has a broken segment VJP)
+    if d.sequence_parallel and d.tp_size > 1:
+        sp_rs = [op for op in eff if op.kind == "reduce_scatter"
+                 and op.group_size == d.tp_size]
+        sp_ag = [op for op in eff if op.kind == "all_gather"
+                 and op.group_size == d.tp_size]
+        if not sp_rs:
+            rep.add(CHECK, ERROR, "reduce_scatter",
+                    f"sequence_parallel with tp_size={d.tp_size} but no "
+                    f"reduce-scatter over tp: the Megatron-SP row-parallel "
+                    f"exit (g) is missing — partial block outputs are "
+                    f"never reduced across tp shards")
+        if not sp_ag:
+            rep.add(CHECK, ERROR, "all_gather",
+                    f"sequence_parallel with tp_size={d.tp_size} but no "
+                    f"all-gather over tp: the SP column-parallel entry "
+                    f"(f) is missing — the seq-sharded residual stream "
+                    f"never re-assembles the full sequence")
+        if sp_rs and sp_ag:
+            rep.add(CHECK, INFO, "sp_pair",
+                    f"SP f/g pair present over tp ({len(sp_ag)} "
+                    f"all-gather, {len(sp_rs)} reduce-scatter ops of "
+                    f"group size {d.tp_size})")
+    if d.cp_size > 1:
+        if cfg.model.attn_impl == "ulysses":
+            cp_a2a = [op for op in eff if op.kind == "all_to_all"
+                      and op.group_size == d.cp_size]
+            if not cp_a2a:
+                rep.add(CHECK, ERROR, "all_to_all",
+                        f"attn_impl='ulysses' with cp_size={d.cp_size} "
+                        f"but no all_to_all of group size {d.cp_size}: "
+                        f"the Ulysses seq<->head trade is missing")
+        elif not any(op.kind == "collective_permute" for op in eff):
+            rep.add(CHECK, ERROR, "collective_permute",
+                    f"cp_size={d.cp_size} (ring attention) but the "
+                    f"lowered step contains no collective_permute: the "
+                    f"K/V ring is missing")
 
     # -- budget rule: the accidental-replication detector ------------------
     if budget_bytes is None and state is not None:

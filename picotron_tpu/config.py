@@ -510,9 +510,10 @@ class TrainingConfig:
     # microbatch at SmolLM-1.7B, PERF.md r5); "fused" runs the manual
     # backward layer scan (parallel/fused_bwd.py) that accumulates each
     # layer's dW in-scan, eliminating both passes. "auto" picks "fused"
-    # whenever it is supported (dense, pp=cp=1, no SP, remat dots_attn)
-    # and gradient accumulation is in play. Numerics match the AD engine
-    # (pinned by tests/test_fused_bwd.py).
+    # whenever it is supported (any single-pipeline-stage layout —
+    # dp/tp/SP/cp ring|ulysses/ep/MoE — under remat dots_attn; see the
+    # README eligibility matrix) and gradient accumulation is in play.
+    # Numerics match the AD engine (pinned by tests/test_fused_bwd.py).
     grad_engine: str = "auto"
 
 
@@ -771,11 +772,13 @@ class Config:
 
             if not fused_bwd_supported(self):
                 raise ValueError(
-                    "grad_engine='fused' requires the dense single-stage "
-                    "path: pp_size=cp_size=1, no sequence_parallel, no "
-                    "MoE, remat with remat_policy='dots_attn', and "
-                    "attn_impl in auto/flash/reference (use 'auto' to "
-                    "fall back to the AD engine automatically)")
+                    "grad_engine='fused' requires a single pipeline stage "
+                    "(pp_size=1) and remat with remat_policy='dots_attn' "
+                    "— the save set the manual backward is derived from. "
+                    "dp/tp/sequence_parallel/cp (ring and ulysses)/ep/MoE "
+                    "all compose (see the README grad-engine eligibility "
+                    "matrix); use 'auto' to fall back to the AD engine "
+                    "automatically")
         if t.optimizer_offload:
             # zero1 COMPOSES with offload (r5): the host master/moments
             # shard over the fused data axes, each process streams 1/dp

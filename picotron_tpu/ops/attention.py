@@ -29,6 +29,78 @@ def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
     return jnp.broadcast_to(x[:, :, :, None, :], (b, s, kv, n_rep, d)).reshape(b, s, kv * n_rep, d)
 
 
+def sdpa_attention_bwd_from_saved(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    out: jnp.ndarray,
+    lse: jnp.ndarray,
+    dout: jnp.ndarray,
+    *,
+    causal: bool = True,
+    q_positions: jnp.ndarray | None = None,
+    kv_positions: jnp.ndarray | None = None,
+    sm_scale: float | None = None,
+):
+    """(dq, dk, dv) from the forward's saved (out, lse) — the flash-attn-2
+    backward identity in plain jnp, the reference twin of the Pallas
+    backward kernels (ops/flash_attention.py `_bwd`):
+
+        p  = exp(s - lse)            (GLOBALLY normalized probabilities)
+        dv = pᵀ @ dout
+        ds = p * (dout @ vᵀ - delta),  delta = rowsum(dout * out)
+        dq = ds @ k * scale,  dk = dsᵀ @ q * scale
+
+    Because `p` is normalized by the *saved* lse (not a recomputed local
+    one), calling this on one K/V block of a larger attention — with the
+    block's positions and the GLOBAL (out, lse, dout) — yields exactly that
+    block's additive contribution to the global gradients. That property is
+    what the context-parallel ring backward sums over visiting blocks
+    (ops/ring_attention.py ring_attention_bwd_from_saved); it does NOT hold
+    for AD of a per-block forward, which normalizes by the block-local lse.
+
+    Shapes follow sdpa_attention: q/out/dout [B, Sq, Hq, D]; k/v
+    [B, Sk, Hkv, D] (GQA unexpanded — the group's query-head grads sum into
+    the kv head); lse [B, Hq, Sq] fp32. Rows with no visible keys
+    (lse = -inf) contribute zero everywhere.
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    n_rep = h // k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+    kx = repeat_kv(k, n_rep)
+    vx = repeat_kv(v, n_rep)
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, kx,
+                        preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        qp = q_positions if q_positions is not None else jnp.arange(sq)
+        kp = kv_positions if kv_positions is not None else jnp.arange(sk)
+        mask = qp[:, None] >= kp[None, :]
+        scores = jnp.where(mask[None, None, :, :], scores, -1e30)
+    lse_f = lse.astype(jnp.float32)[..., None]        # [B, H, Sq, 1]
+    # exp(-1e30 - lse) underflows to exactly 0 for masked entries; a row
+    # with lse = -inf (no visible keys anywhere) must also contribute 0.
+    p = jnp.exp(scores - jnp.maximum(lse_f, -1e30))
+    p = jnp.where(jnp.isinf(lse_f) & (lse_f < 0), 0.0, p)
+
+    do32 = dout.astype(jnp.float32)
+    delta = jnp.sum(do32 * out.astype(jnp.float32), axis=-1)  # [B, Sq, Hq]
+    delta = jnp.transpose(delta, (0, 2, 1))[..., None]        # [B, H, Sq, 1]
+    dv_x = jnp.einsum("bhqk,bqhd->bkhd", p, do32)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", do32, vx.astype(jnp.float32))
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds,
+                    kx.astype(jnp.float32)) * sm_scale
+    dk_x = jnp.einsum("bhqk,bqhd->bkhd", ds,
+                      q.astype(jnp.float32)) * sm_scale
+    if n_rep > 1:
+        dk_x = dk_x.reshape(b, sk, h // n_rep, n_rep, d).sum(axis=3)
+        dv_x = dv_x.reshape(b, sk, h // n_rep, n_rep, d).sum(axis=3)
+    return (dq.astype(q.dtype), dk_x.astype(k.dtype), dv_x.astype(v.dtype))
+
+
 def sdpa_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,

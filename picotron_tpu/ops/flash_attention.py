@@ -799,23 +799,40 @@ def flash_attention_bwd_from_saved(
     the flat matmul-layout tensors. The LSE cotangent is zero by contract
     (training consumes `out` only).
 
-    On non-TPU backends this recomputes via AD of the public entry — the
-    same sdpa fallback dispatch, so CPU-mesh parity tests exercise the
-    identical math.
+    Contract: the gradients are computed FROM the passed (out, lse) — the
+    probabilities are normalized by the saved lse, never a recomputed local
+    one. Called on one K/V block of a larger attention with the block's
+    positions and the GLOBAL (out, lse, dout), the result is that block's
+    additive contribution to the global (dq, dk, dv) — the property the
+    context-parallel backwards sum over (ring_attention_bwd_from_saved /
+    ulysses_attention_bwd_from_saved). On non-TPU backends the identical
+    math runs as plain jnp (ops.attention.sdpa_attention_bwd_from_saved),
+    so CPU-mesh parity tests exercise the same structure as the kernels.
     """
     b, sq, hq, d = q.shape
     sk = k.shape[1]
     if sm_scale is None:
         sm_scale = 1.0 / (d ** 0.5)
     if interpret is None and jax.default_backend() != "tpu":
-        def f(q_, k_, v_):
-            return flash_attention(
-                q_, k_, v_, causal=causal, q_positions=q_positions,
-                kv_positions=kv_positions, sm_scale=sm_scale, rope=rope,
-                block_q=block_q, block_k=block_k)
+        from picotron_tpu.ops.attention import sdpa_attention_bwd_from_saved
+        from picotron_tpu.ops.rope import apply_rope
 
-        _, vjp_fn = jax.vjp(f, q, k, v)
-        return vjp_fn(dout)
+        if rope is None:
+            return sdpa_attention_bwd_from_saved(
+                q, k, v, out, lse, dout, causal=causal,
+                q_positions=q_positions, kv_positions=kv_positions,
+                sm_scale=sm_scale)
+        # q/k arrive unrotated; grads map back through the rotation's
+        # transpose — jax.vjp over apply_rope is that transpose exactly.
+        (qr, kr), rot_vjp = jax.vjp(
+            lambda q_, k_: (apply_rope(q_, *rope, q_positions),
+                            apply_rope(k_, *rope, kv_positions)), q, k)
+        dqr, dkr, dv = sdpa_attention_bwd_from_saved(
+            qr, kr, v, out, lse, dout, causal=causal,
+            q_positions=q_positions, kv_positions=kv_positions,
+            sm_scale=sm_scale)
+        dq, dk = rot_vjp((dqr, dkr))
+        return dq, dk, dv
     interpret = bool(interpret)
     static_causal = (causal and q_positions is None
                      and kv_positions is None)

@@ -137,6 +137,16 @@ def moe_mlp(
     capacity drops are otherwise silent). `ep_axis` names the mesh axis for
     the all_to_all pair; None = no expert parallelism (single device, or
     ep = 1). `stat_axes` makes the router statistics global (route_topk).
+
+    Recompute contract: every op here is a deterministic function of
+    (x, weights) — fp32 router logits, top_k, the slot cumsum, the
+    capacity bound — so re-running this block on the same inputs
+    reproduces the forward's routing bit-identically. Both remat (the AD
+    engine under the dots/dots_attn policies) and the fused grad engine's
+    backward segment VJP (parallel/fused_bwd.py) rely on that: they
+    recompute the whole expert block from the saved layer input instead
+    of saving the [E, C, H] dispatch buffers, and a nondeterministic
+    tie-break here would silently diverge their gradients.
     """
     b, s, h = x.shape
     n = b * s

@@ -16,11 +16,17 @@ Design differences from the reference, all deliberate:
   scheduler overlaps it with the blockwise attention compute — the manual
   comm/compute overlap the reference codes by hand (ref:
   context_parallel.py:30-45).
-- **No custom backward.** The reference hand-writes a 110-line autograd
-  Function whose backward runs a second ring for dK/dV accumulators (ref:
-  context_parallel.py:54-110) because torch cannot differentiate through its
-  P2P calls. JAX transposes `ppermute` natively (the transpose is the inverse
-  permutation), so reverse-mode AD derives exactly that dK/dV ring for free.
+- **No custom backward on the AD path.** The reference hand-writes a
+  110-line autograd Function whose backward runs a second ring for dK/dV
+  accumulators (ref: context_parallel.py:54-110) because torch cannot
+  differentiate through its P2P calls. JAX transposes `ppermute` natively
+  (the transpose is the inverse permutation), so reverse-mode AD derives
+  exactly that dK/dV ring for free. The fused grad engine — which never
+  re-runs the forward — instead enters through
+  `ring_attention_bwd_from_saved`: `return_lse=True` saves the globally
+  merged LSE, and the backward is a second forward ring whose per-block
+  grads (normalized by the saved LSE) are exactly additive, with dK/dV
+  accumulators traveling the ring alongside their blocks.
 - **GQA-aware**: the unexpanded K/V heads travel the ring (smaller transfers);
   head expansion happens inside the blockwise kernel.
 - **Positions are explicit.** Causality across blocks is decided by global
@@ -87,6 +93,7 @@ def ring_attention(
     axis: str = "cp",
     q_positions: jnp.ndarray | None = None,
     attn_block=None,
+    return_lse: bool = False,
 ) -> jnp.ndarray:
     """Causal ring attention over the named mesh axis `axis`.
 
@@ -101,8 +108,13 @@ def ring_attention(
     attn_block: blockwise attention implementation with the signature of
         `sdpa_attention(..., return_lse=True)`; defaults to the jnp reference
         path (the Pallas flash kernel slots in here).
+    return_lse: also return the GLOBALLY merged log-sum-exp
+        [B, Hq, S_local] fp32 — the per-shard statistic the fused grad
+        engine saves so `ring_attention_bwd_from_saved` can run the
+        backward ring without re-running the forward.
 
-    Returns [B, S_local, Hq, D] in q.dtype.
+    Returns [B, S_local, Hq, D] in q.dtype (and the merged lse when
+    `return_lse`).
     """
     n = lax.psum(1, axis)  # static axis size
     s_local = q.shape[1]
@@ -158,4 +170,99 @@ def ring_attention(
             v = lax.ppermute(v, axis, fwd_perm)
             kv_positions = lax.ppermute(kv_positions, axis, fwd_perm)
 
+    if return_lse:
+        return out_acc.astype(q.dtype), lse_acc
     return out_acc.astype(q.dtype)
+
+
+def ring_attention_bwd_from_saved(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    out: jnp.ndarray,
+    lse: jnp.ndarray,
+    dout: jnp.ndarray,
+    axis: str = "cp",
+    q_positions: jnp.ndarray | None = None,
+    sm_scale: float | None = None,
+    block_bwd=None,
+):
+    """(dq, dk, dv) for the causal K/V ring from the forward's saved
+    (out, lse) — the manual-VJP entry for the fused grad engine
+    (parallel/fused_bwd.py), mirroring `flash_attention_bwd_from_saved`.
+
+    The forward ring's saved statistics make the backward a SECOND forward
+    ring, not a transpose of the first: because the saved lse is the
+    globally merged one, each visiting block's grads computed against it
+    (p = exp(s - lse_global); delta = rowsum(dout·out) global) are that
+    block's exact additive contribution to the global gradients — the
+    structure the reference hand-writes as its 110-line backward ring
+    (ref: context_parallel.py:54-110) and that Mesh-Attention (arxiv
+    2512.20968) exploits for communication-efficient distributed backward.
+    dQ accumulates locally; each visiting block's dK/dV accumulators travel
+    the ring WITH their block (the same forward `ppermute` permutation) and
+    a final ppermute delivers them home after the full circle.
+
+    Shapes follow `ring_attention`: q/out/dout [B, S_local, Hq, D], k/v
+    [B, S_local, Hkv, D], lse [B, Hq, S_local] fp32 (the `return_lse`
+    form). q/k arrive in the same (pre-rotated) form the forward ring
+    consumed. `block_bwd` has `flash_attention_bwd_from_saved`'s signature
+    (the default; the sdpa twin runs on non-TPU backends). Fully-future
+    visiting blocks skip their kernel via the same collective-free
+    `lax.cond` as the forward — their contribution is exactly zero.
+    """
+    from picotron_tpu.ops.flash_attention import flash_attention_bwd_from_saved
+
+    n = lax.psum(1, axis)
+    s_local = q.shape[1]
+    my = lax.axis_index(axis)
+    if q_positions is None:
+        q_positions = my * s_local + jnp.arange(s_local)
+    if block_bwd is None:
+        block_bwd = flash_attention_bwd_from_saved
+
+    dq_acc = jnp.zeros(q.shape, jnp.float32)
+    dk_acc = jnp.zeros(k.shape, jnp.float32)
+    dv_acc = jnp.zeros(v.shape, jnp.float32)
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    kv_positions = q_positions
+    q_max = jnp.max(q_positions)
+
+    for step in range(n):
+        kv_pos = kv_positions
+
+        def compute(opnds, kv_pos=kv_pos):
+            q_, k_, v_ = opnds
+            dq_b, dk_b, dv_b = block_bwd(
+                q_, k_, v_, out, lse, dout, causal=True,
+                q_positions=q_positions, kv_positions=kv_pos,
+                sm_scale=sm_scale)
+            return (dq_b.astype(jnp.float32), dk_b.astype(jnp.float32),
+                    dv_b.astype(jnp.float32))
+
+        def skip(opnds):
+            q_, k_, v_ = opnds
+            a = (q_.ravel()[0] + k_.ravel()[0]
+                 + v_.ravel()[0]).astype(jnp.float32) * 0.0
+            return (jnp.zeros(q_.shape, jnp.float32) + a,
+                    jnp.zeros(k_.shape, jnp.float32) + a,
+                    jnp.zeros(v_.shape, jnp.float32) + a)
+
+        fully_masked = jnp.min(kv_pos) > q_max
+        dq_b, dk_b, dv_b = lax.cond(fully_masked, skip, compute, (q, k, v))
+        dq_acc = dq_acc + dq_b
+        dk_acc = dk_acc + dk_b
+        dv_acc = dv_acc + dv_b
+        if step != n - 1:
+            k = lax.ppermute(k, axis, fwd_perm)
+            v = lax.ppermute(v, axis, fwd_perm)
+            kv_positions = lax.ppermute(kv_positions, axis, fwd_perm)
+            dk_acc = lax.ppermute(dk_acc, axis, fwd_perm)
+            dv_acc = lax.ppermute(dv_acc, axis, fwd_perm)
+    # After n-1 rotations this device holds block (my+1) mod n and its
+    # accumulated grads; one more forward hop delivers every block's dK/dV
+    # back to its owner (n hops total = the identity permutation).
+    dk_acc = lax.ppermute(dk_acc, axis, fwd_perm)
+    dv_acc = lax.ppermute(dv_acc, axis, fwd_perm)
+    return (dq_acc.astype(q.dtype), dk_acc.astype(k.dtype),
+            dv_acc.astype(v.dtype))

@@ -20,6 +20,13 @@ position-masked flash kernel handles it unchanged.
 Constraint: local head counts (after TP) must be divisible by cp — q AND kv
 heads (GQA); config.validate enforces it. The ring has no such constraint,
 which is why both schedules exist (`attn_impl: "ring" | "ulysses"`).
+
+The fused grad engine enters through `ulysses_attention_bwd_from_saved`:
+the forward (`return_lse=True`) saves the INNER-domain LSE, and the
+backward replays the identical all_to_all pair in both directions around
+the flash bwd-from-saved kernel — the forward kernel never re-runs.
+`ulysses_static_layout` is the single source of the gathered-sequence
+layout both directions share.
 """
 
 from __future__ import annotations
@@ -28,6 +35,27 @@ from typing import Callable, Optional
 
 import jax.numpy as jnp
 from jax import lax
+
+
+def ulysses_static_layout(cfg):
+    """(full_positions, seq_sort) for the GATHERED sequence, as trace-time
+    numpy constants derived from the config's cp layout — the single source
+    both the forward wiring (parallel/api.py) and the fused grad engine
+    (parallel/fused_bwd.py) build their Ulysses calls from, so the two
+    paths cannot disagree about the gathered order. full_positions is the
+    dataloader's layout permutation (arange when contiguous); seq_sort is
+    the static argsort restoring a monotone sequence (None when already
+    monotone), which re-enables the flash kernel's static-causal fast
+    path."""
+    import numpy as np
+
+    from picotron_tpu.data import cp_sequence_permutation
+
+    layout_perm = cp_sequence_permutation(cfg)
+    full_pos = (np.asarray(layout_perm) if layout_perm is not None
+                else np.arange(cfg.training.seq_length))
+    seq_sort = np.argsort(full_pos) if layout_perm is not None else None
+    return full_pos, seq_sort
 
 
 def _scatter_heads(x: jnp.ndarray, axis: str) -> jnp.ndarray:
@@ -53,6 +81,7 @@ def ulysses_attention(
     seq_sort=None,
     full_positions=None,
     positions_static: bool = False,
+    return_lse: bool = False,
 ) -> jnp.ndarray:
     """Full-sequence attention over seq-sharded q/k/v [B, S_local, H, D].
 
@@ -81,8 +110,47 @@ def ulysses_attention(
     config — so no runtime tracer-probing is needed here (the old
     `isinstance(..., jax.core.Tracer)` probe leaned on a semi-private
     namespace; ADVICE r5 / the shardcheck source lint forbids it).
+
+    return_lse: also return the inner attention's log-sum-exp
+    [B, H_local, S] fp32, in the INNER (head-sharded, seq_sort-ed) domain —
+    the save the fused grad engine pairs with
+    `ulysses_attention_bwd_from_saved`.
     """
-    s_local = q.shape[1]
+    pos_arg, inv = _inner_positions(q.shape[1], axis, q_positions, seq_sort,
+                                    full_positions, positions_static)
+    qh = _scatter_heads(q, axis)
+    kh = _scatter_heads(k, axis)
+    vh = _scatter_heads(v, axis)
+    if seq_sort is not None:
+        qh, kh, vh = (x[:, seq_sort] for x in (qh, kh, vh))
+    kwargs = {} if rope is None else {"rope": rope}
+    if return_lse:
+        out, lse = attn_fn(qh, kh, vh, causal=True, q_positions=pos_arg,
+                           kv_positions=pos_arg, return_lse=True, **kwargs)
+    else:
+        out = attn_fn(qh, kh, vh, causal=True, q_positions=pos_arg,
+                      kv_positions=pos_arg, **kwargs)
+    if seq_sort is not None:
+        out = out[:, inv]
+    out = _gather_heads(out, axis)
+    # lse stays in the INNER (head-sharded, sorted, full-sequence) domain
+    # [B, H_local, S] fp32: the backward re-derives the inner q/k/v/out by
+    # re-running the exact all_to_all + sort permutations (bit-exact), so
+    # the lse never needs un/re-sorting round trips.
+    return (out, lse) if return_lse else out
+
+
+def _inner_positions(s_local: int, axis: str, q_positions, seq_sort,
+                     full_positions, positions_static: bool):
+    """(pos_arg, inv) for the inner full-sequence attention: the gathered
+    (and seq_sort-ed) position vector — or None when it is STATICALLY the
+    plain 0..S-1 (contiguous layout, or zigzag restored by seq_sort), so
+    the kernel's static-causal fast path fires (program-id block classes +
+    DMA-free skipped tiles; the long-sequence path where that ~20% kernel
+    overhead matters most, code review r5). Static-ness is decidable only
+    for trace-time-known positions, which the caller declares via
+    `positions_static`. `inv` is the static un-sort permutation (None when
+    no sort)."""
     if full_positions is not None:
         pos_full = jnp.asarray(full_positions)
     else:
@@ -93,21 +161,10 @@ def ulysses_attention(
         # positions of the gathered sequence, in the same device-order the
         # all_to_all concatenates shards
         pos_full = lax.all_gather(q_positions, axis, axis=0, tiled=True)
-
-    qh = _scatter_heads(q, axis)
-    kh = _scatter_heads(k, axis)
-    vh = _scatter_heads(v, axis)
+    inv = None
     if seq_sort is not None:
         inv = jnp.argsort(jnp.asarray(seq_sort))
         pos_full = pos_full[seq_sort]
-        qh, kh, vh = (x[:, seq_sort] for x in (qh, kh, vh))
-    # When the (possibly sorted) gathered positions are STATICALLY the
-    # plain 0..S-1 — contiguous layout, or zigzag restored by seq_sort —
-    # hand the kernel positions=None so its static-causal fast path fires
-    # (program-id block classes + DMA-free skipped tiles; this is the
-    # long-sequence path where that ~20% kernel overhead matters most,
-    # code review r5). Decidable only for trace-time-known positions,
-    # which the caller declares via `positions_static`.
     pos_arg = pos_full
     if full_positions is not None and positions_static:
         import numpy as np
@@ -117,9 +174,59 @@ def ulysses_attention(
             fp = fp[np.asarray(seq_sort)]
         if np.array_equal(fp, np.arange(fp.shape[0])):
             pos_arg = None
-    kwargs = {} if rope is None else {"rope": rope}
-    out = attn_fn(qh, kh, vh, causal=True, q_positions=pos_arg,
-                  kv_positions=pos_arg, **kwargs)
+    return pos_arg, inv
+
+
+def ulysses_attention_bwd_from_saved(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    out: jnp.ndarray,
+    lse: jnp.ndarray,
+    dout: jnp.ndarray,
+    *,
+    axis: str = "cp",
+    q_positions: Optional[jnp.ndarray] = None,
+    attn_bwd: Optional[Callable] = None,
+    rope=None,
+    seq_sort=None,
+    full_positions=None,
+    positions_static: bool = False,
+    sm_scale: Optional[float] = None,
+):
+    """(dq, dk, dv) for Ulysses attention from the forward's saved
+    (out, lse) — the manual-VJP entry for the fused grad engine
+    (parallel/fused_bwd.py), mirroring `flash_attention_bwd_from_saved`.
+
+    The backward reuses the forward's all_to_all pair in both directions:
+    q/k/v/out/dout (outer domain, [B, S_local, H, D]) scatter to the inner
+    head-sharded full-sequence domain, `attn_bwd` (the flash
+    bwd-from-saved; sdpa twin on non-TPU) runs there against the saved
+    inner-domain lse [B, H_local, S] — never re-running the forward kernel
+    — and the grads ride the reverse all_to_all home. seq_sort/
+    full_positions/positions_static follow `ulysses_attention`'s contract
+    and MUST match the forward call's values (both sides derive them from
+    `ulysses_static_layout`).
+    """
+    from picotron_tpu.ops.flash_attention import flash_attention_bwd_from_saved
+
+    if attn_bwd is None:
+        attn_bwd = flash_attention_bwd_from_saved
+    pos_arg, inv = _inner_positions(q.shape[1], axis, q_positions, seq_sort,
+                                    full_positions, positions_static)
+    qh = _scatter_heads(q, axis)
+    kh = _scatter_heads(k, axis)
+    vh = _scatter_heads(v, axis)
+    oh = _scatter_heads(out, axis)
+    doh = _scatter_heads(dout, axis)
     if seq_sort is not None:
-        out = out[:, inv]
-    return _gather_heads(out, axis)
+        qh, kh, vh, oh, doh = (x[:, seq_sort]
+                               for x in (qh, kh, vh, oh, doh))
+    kwargs = {} if rope is None else {"rope": rope}
+    dqh, dkh, dvh = attn_bwd(qh, kh, vh, oh, lse, doh, causal=True,
+                             q_positions=pos_arg, kv_positions=pos_arg,
+                             sm_scale=sm_scale, **kwargs)
+    if seq_sort is not None:
+        dqh, dkh, dvh = (x[:, inv] for x in (dqh, dkh, dvh))
+    return (_gather_heads(dqh, axis), _gather_heads(dkh, axis),
+            _gather_heads(dvh, axis))

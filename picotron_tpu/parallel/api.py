@@ -98,21 +98,18 @@ def make_parallel_ctx(cfg: Config) -> ParallelCtx:
         from picotron_tpu.ops.attention import sdpa_attention as attn_fn
 
     if d.cp_size > 1 and cfg.model.attn_impl == "ulysses":
-        import numpy as np
-
-        from picotron_tpu.data import cp_sequence_permutation
-        from picotron_tpu.ops.ulysses import ulysses_attention
+        from picotron_tpu.ops.ulysses import (
+            ulysses_attention, ulysses_static_layout,
+        )
 
         # the gathered sequence's global positions are exactly the
         # dataloader's layout permutation (arange when contiguous) — known
         # at trace time, so no runtime position all_gather is needed, and a
         # static argsort restores a monotone sequence so the kernel's
-        # causal fast paths fire
-        layout_perm = cp_sequence_permutation(cfg)
-        full_pos = (np.asarray(layout_perm) if layout_perm is not None
-                    else np.arange(cfg.training.seq_length))
-        seq_sort = (np.argsort(full_pos)
-                    if layout_perm is not None else None)
+        # causal fast paths fire. Derived by ulysses_static_layout — the
+        # same source the fused grad engine's backward uses, so the two
+        # sides cannot disagree about the gathered order.
+        full_pos, seq_sort = ulysses_static_layout(cfg)
 
         def attn(q, k, v, pos, rope):
             # one all_to_all pair trades the seq shard for a head shard;
@@ -308,9 +305,10 @@ def _device_grads(params, batch, cfg: Config):
         if use_fused:
             # manual backward layer scan accumulating dW in-scan: no
             # per-microbatch grad tree, no whole-tree adds (fused_bwd.py)
-            g_acc, total, count = fused_micro_grads(
+            g_acc, total, count, dropw = fused_micro_grads(
                 params, mb_ids, mb_tgt, g_acc, cfg, ctx)
-            return (g_acc, l_acc + total, c_acc + count, d_acc), None
+            return (g_acc, l_acc + total, c_acc + count,
+                    d_acc + dropw), None
         (total, (count, dropw)), grads = jax.value_and_grad(
             nll_sum, has_aux=True)(params, mb_ids, mb_tgt)
         return (jax.tree.map(jnp.add, g_acc, grads), l_acc + total,

@@ -62,6 +62,13 @@ def vocab_parallel_embed(w_shard: jnp.ndarray, ids: jnp.ndarray,
 # tiled all_gather) and the row-parallel exit reduce-scatters the partial
 # sums (backward: all_gather). Same total bytes as the psum pair they
 # replace; tp x less activation memory between blocks.
+#
+# These transposes are load-bearing beyond AD: the fused grad engine
+# (parallel/fused_bwd.py) reaches both hooks through jax.vjp over segment
+# closures, so its manual backward scan emits the SAME all_gather/
+# reduce-scatter pair per layer as the AD engine — the schedule
+# picotron_tpu/analysis/collectives.py's SP presence rule audits on both
+# engines.
 
 
 def sp_gather_seq(x: jnp.ndarray, axis: str = "tp") -> jnp.ndarray:

@@ -1,20 +1,34 @@
 """Fused-accumulation grad engine (parallel/fused_bwd.py) parity.
 
 The fused engine re-derives the decoder backward by hand (manual layer
-scan, in-scan dW accumulation, flash-bwd-from-saved) — every test here
-pins it against the AD engine on the same config, so any divergence in
-the re-implemented forward/backward math shows up as a loss/grad mismatch.
-Tolerances are bf16-activation-level: both engines compute per-layer dW
-in bf16 before the fp32 accumulate, but XLA fuses the two graphs
-differently.
+scan, in-scan dW accumulation, *_bwd_from_saved attention backwards) —
+every test here pins it against the AD engine on the same config, so any
+divergence in the re-implemented forward/backward math shows up as a
+loss/grad mismatch.
+
+Two tiers of pinning:
+
+- `assert_grads_match` compares the raw fp32 gradient trees of ONE
+  `_device_grads` call per engine (model dtype float32, no optimizer):
+  deterministic to ~1e-6, runs on pre-vma JAX too (both engines share the
+  same collectives, so the check_rep=False transpose caveat cancels), and
+  covers every eligibility axis — tp, SP, cp ring (contiguous + zigzag),
+  Ulysses, MoE (+ep, +capacity drops).
+- `assert_engines_match` runs two full optimizer steps (bf16 + offload,
+  the production arrangement) and compares losses + fp32 masters.
+  Tolerances are bf16-activation-level: both engines compute per-layer dW
+  in bf16 before the fp32 accumulate, but XLA fuses the two graphs
+  differently. vma-only (see `requires_vma`).
 """
 
 import dataclasses
+from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from jax.sharding import PartitionSpec as P
 
 from picotron_tpu import compat
 from picotron_tpu.config import (
@@ -68,6 +82,48 @@ def assert_engines_match(mk=None, dk=None, **tr):
         np.testing.assert_allclose(a, b, rtol=3e-3, atol=3e-5)
 
 
+# ---------------------------------------------------------------------------
+# raw fp32 gradient parity — one _device_grads call per engine
+# ---------------------------------------------------------------------------
+
+
+def fp32_cfg(engine, mk=None, dk=None, **tr):
+    return engine_cfg(engine, model_kw={"dtype": "float32", **(mk or {})},
+                      dist_kw=dk, optimizer_offload=False, **tr)
+
+
+def device_grads_of(cfg):
+    """(grads, loss, extras) from one jitted _device_grads call — the
+    engines' actual output, before any optimizer touches it."""
+    from picotron_tpu.parallel.api import _device_grads, init_sharded_state
+    from picotron_tpu.parallel.sharding import batch_spec, param_specs
+
+    batch, menv = batch_for(cfg)
+    state = init_sharded_state(cfg, menv, jax.random.key(0))
+    fn = jax.jit(compat.shard_map(
+        partial(_device_grads, cfg=cfg), mesh=menv.mesh,
+        in_specs=(param_specs(cfg), (batch_spec(), batch_spec())),
+        out_specs=(param_specs(cfg), P(), P())))
+    grads, loss, extras = fn(state.params, batch)
+    return (jax.tree.map(np.asarray, grads), float(loss),
+            {k: float(v) for k, v in extras.items()})
+
+
+def assert_grads_match(mk=None, dk=None, **tr):
+    g_ad, l_ad, e_ad = device_grads_of(fp32_cfg("ad", mk, dk, **tr))
+    g_f, l_f, e_f = device_grads_of(fp32_cfg("fused", mk, dk, **tr))
+    np.testing.assert_allclose(l_f, l_ad, rtol=2e-4)
+    assert set(e_f) == set(e_ad)
+    for k in e_ad:
+        np.testing.assert_allclose(e_f[k], e_ad[k], rtol=1e-5, err_msg=k)
+    flat_ad = jax.tree_util.tree_flatten_with_path(g_ad)[0]
+    for (path, a), b in zip(flat_ad, jax.tree.leaves(g_f)):
+        scale = np.abs(a).max() + 1e-12
+        np.testing.assert_array_less(
+            np.abs(a - b).max() / scale, 1e-4,
+            err_msg=f"{jax.tree_util.keystr(path)} (rel-to-max)")
+
+
 @requires_vma
 def test_parity_dense_dp():
     assert_engines_match()
@@ -107,18 +163,127 @@ def test_auto_resolves_fused_only_when_supported():
     from picotron_tpu.parallel.fused_bwd import fused_bwd_supported
 
     assert fused_bwd_supported(engine_cfg("auto"))
+    # the widened axes (this PR): SP, cp ring/ulysses, MoE (+ep)
+    assert fused_bwd_supported(
+        engine_cfg("auto", dist_kw={"dp_size": 2, "tp_size": 2,
+                                    "sequence_parallel": True}))
+    assert fused_bwd_supported(
+        engine_cfg("auto", dist_kw={"dp_size": 2, "cp_size": 2}))
+    assert fused_bwd_supported(
+        engine_cfg("auto", dist_kw={"cp_size": 2},
+                   model_kw={"attn_impl": "ulysses"}))
+    assert fused_bwd_supported(
+        engine_cfg("auto", model_kw={"num_experts": 4,
+                                     "num_experts_per_token": 2}))
+    assert fused_bwd_supported(
+        engine_cfg("auto", dist_kw={"dp_size": 2, "ep_size": 2},
+                   model_kw={"num_experts": 4,
+                             "num_experts_per_token": 2}))
+    # still AD-only: pp > 1, non-dots_attn remat, remat off
     assert not fused_bwd_supported(
         engine_cfg("auto", dist_kw={"dp_size": 2, "pp_size": 2}))
     assert not fused_bwd_supported(
         engine_cfg("auto", remat_policy="dots"))
-    assert not fused_bwd_supported(
-        engine_cfg("auto", model_kw={"num_experts": 4,
-                                     "num_experts_per_token": 2}))
+    assert not fused_bwd_supported(engine_cfg("auto", remat=False))
 
 
 def test_fused_rejects_unsupported_config():
     with pytest.raises(ValueError, match="fused"):
         engine_cfg("fused", remat_policy="dots").validate()
+    with pytest.raises(ValueError, match="fused"):
+        engine_cfg("fused",
+                   dist_kw={"dp_size": 2, "pp_size": 2}).validate()
+
+
+# ---------------------------------------------------------------------------
+# per-axis fp32 gradient parity (run on pre-vma JAX too — see module doc)
+# ---------------------------------------------------------------------------
+
+
+def test_grads_parity_sequence_parallel():
+    # Megatron-SP: the ctx.f/g all_gather / reduce-scatter pair inside the
+    # fused engine's segment VJPs, seq-sharded saved layer inputs
+    assert_grads_match(dk={"dp_size": 2, "tp_size": 2,
+                           "sequence_parallel": True})
+
+
+def test_grads_parity_cp4_ring_zigzag():
+    # ring backward from the saved merged LSE: a second ppermute ring
+    # carrying dK/dV accumulators, zigzag positions traveling with blocks
+    assert_grads_match(dk={"dp_size": 2, "cp_size": 4})
+
+
+def test_grads_parity_cp2_ulysses():
+    # Ulysses backward: the all_to_all pair in both directions around the
+    # bwd-from-saved kernel, inner-domain saved LSE, static zigzag sort
+    assert_grads_match(dk={"dp_size": 2, "cp_size": 2},
+                       mk={"attn_impl": "ulysses"})
+
+
+def test_grads_parity_moe_ep():
+    # MoE segment VJP: routing recomputed from the saved layer input,
+    # router aux fold (aux * count) gradient, expert-parallel all_to_all
+    assert_grads_match(dk={"dp_size": 2, "ep_size": 2},
+                       mk={"num_experts": 4, "num_experts_per_token": 2})
+
+
+@pytest.mark.slow
+def test_grads_parity_cp2_ring_contiguous():
+    assert_grads_match(dk={"dp_size": 2, "cp_size": 2,
+                           "cp_layout": "contiguous"})
+
+
+@pytest.mark.slow
+def test_grads_parity_moe_capacity_drops():
+    # a tight capacity bound forces real drops: the drop statistic must
+    # ride the fused path into extras identically, and dropped tokens'
+    # zero-contribution must match the AD engine's
+    assert_grads_match(
+        dk={"dp_size": 2, "ep_size": 2},
+        mk={"num_experts": 4, "num_experts_per_token": 2,
+            "capacity_factor": 0.25})
+    _, _, extras = device_grads_of(fp32_cfg(
+        "fused", {"num_experts": 4, "num_experts_per_token": 2,
+                  "capacity_factor": 0.25},
+        {"dp_size": 2, "ep_size": 2}))
+    assert extras["moe_drop_frac"] > 0.0
+
+
+@pytest.mark.slow
+def test_grads_parity_sp_qwen_bias_tied():
+    assert_grads_match(dk={"dp_size": 2, "tp_size": 2,
+                           "sequence_parallel": True},
+                       mk={"attention_bias": True,
+                           "tie_word_embeddings": True})
+
+
+@pytest.mark.slow
+@requires_vma
+def test_parity_sequence_parallel_e2e():
+    # full bf16 + offload steps through the optimizer (conventions of the
+    # dense e2e tests above)
+    assert_engines_match(dk={"dp_size": 2, "tp_size": 2,
+                             "sequence_parallel": True})
+
+
+@pytest.mark.slow
+@requires_vma
+def test_parity_cp4_ring_e2e():
+    assert_engines_match(dk={"dp_size": 2, "cp_size": 4})
+
+
+@pytest.mark.slow
+@requires_vma
+def test_parity_cp2_ulysses_e2e():
+    assert_engines_match(dk={"dp_size": 2, "cp_size": 2},
+                         mk={"attn_impl": "ulysses"})
+
+
+@pytest.mark.slow
+@requires_vma
+def test_parity_moe_ep_e2e():
+    assert_engines_match(dk={"dp_size": 2, "ep_size": 2},
+                         mk={"num_experts": 4, "num_experts_per_token": 2})
 
 
 @pytest.mark.slow

@@ -188,6 +188,72 @@ def test_moe_audit_requires_all_to_all():
     assert any("all_to_all" in f.path for f in rep.errors()), rep.render()
 
 
+def _fused_sp_cfg():
+    return mkcfg(dist=dict(dp_size=2, tp_size=2, sequence_parallel=True),
+                 ga=2, train=dict(grad_engine="fused",
+                                  remat_policy="dots_attn"))
+
+
+def test_fused_sp_config_audits_green_not_skipped():
+    """A `grad_engine: fused` + SP config must be AUDITED (the fused
+    engine's manual backward lowers the same SP all-gather/reduce-scatter
+    pair the AD engine's transposes produce), not skipped — the audit
+    records which engine it saw and the presence of the f/g pair."""
+    cfg = _fused_sp_cfg()
+    rep = run_shardcheck(cfg)
+    assert rep.ok(), rep.render(verbose=True)
+    assert rep.info["collectives"]["grad_engine"] == "fused"
+    assert rep.info["collectives"]["reduce_scatter"] > 0
+    assert rep.info["collectives"]["all_gather"] > 0
+
+
+def test_fused_sp_audit_flags_deleted_reduce_scatter():
+    """Negative test: textually delete the SP reduce-scatters from the
+    fused lowering — the audit must flag the missing row-parallel exit."""
+    cfg = _fused_sp_cfg()
+    low = lower_train_step(cfg)
+    mutated = low.text.replace("stablehlo.reduce_scatter",
+                               "stablehlo.xx_gone")
+    rep = audit_collectives(cfg, text=mutated, state=low.state)
+    assert not rep.ok()
+    assert any("reduce-scatter" in f.message and "Megatron-SP" in f.message
+               for f in rep.errors()), rep.render()
+
+
+def test_fused_cp_ring_audit_requires_collective_permute():
+    """cp>1 ring under the fused engine: the K/V ring's collective_permute
+    (forward ring + the backward's dK/dV-carrying ring) must be present;
+    deleting them must flag."""
+    cfg = mkcfg(dist=dict(dp_size=2, cp_size=4), ga=2,
+                train=dict(grad_engine="fused", remat_policy="dots_attn"))
+    low = lower_train_step(cfg)
+    rep = audit_collectives(cfg, text=low.text, state=low.state)
+    assert rep.ok(), rep.render()
+    assert rep.info["collectives"]["grad_engine"] == "fused"
+    assert rep.info["collectives"]["collective_permute"] > 0
+    mutated = low.text.replace("stablehlo.collective_permute",
+                               "stablehlo.xx_gone")
+    bad = audit_collectives(cfg, text=mutated, state=low.state)
+    assert any("K/V ring" in f.message for f in bad.errors()), bad.render()
+
+
+def test_ulysses_audit_requires_cp_all_to_all():
+    cfg = mkcfg(model="debug-tiny",
+                dist=dict(dp_size=2, cp_size=2), ga=2,
+                train=dict(grad_engine="fused", remat_policy="dots_attn"))
+    cfg = dataclasses.replace(
+        cfg, model=dataclasses.replace(cfg.model, attn_impl="ulysses",
+                                       num_attention_heads=8,
+                                       num_key_value_heads=4))
+    cfg.validate()
+    low = lower_train_step(cfg)
+    rep = audit_collectives(cfg, text=low.text, state=low.state)
+    assert rep.ok(), rep.render()
+    mutated = low.text.replace("stablehlo.all_to_all", "stablehlo.xx_gone")
+    bad = audit_collectives(cfg, text=mutated, state=low.state)
+    assert any("Ulysses" in f.message for f in bad.errors()), bad.render()
+
+
 # ---------------------------------------------------------------------------
 # donation + recompilation hazards
 # ---------------------------------------------------------------------------

@@ -358,3 +358,42 @@ def test_bench_decode_harness_smoke():
     assert row["value"] > 0
     assert row["prefill_tokens_per_sec"] > 0
     assert row["batch"] == 2 and row["max_new_tokens"] == 4
+
+
+def test_bench_decode_tp_sharded_smoke():
+    """bench.py --decode --tp 2 on the CPU mesh: the tp-sharded decode
+    plumbing (place_for_decode through run_decode) and the metric naming —
+    greedy token parity of the sharded decode itself is pinned by
+    tests/test_generate.py; the 7B anchor is `--model Llama-2-7B
+    --layers 4` on hardware (VERDICT r5 next #6)."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    row = bench.run_decode("debug-tiny", 0, prompt_len=16, max_new=4,
+                           batch=2, steps=1, tp=2)
+    assert row["tp"] == 2
+    assert row["metric"].endswith("-tp2")
+    assert row["value"] > 0
+
+
+def test_bench_bwd_grid_sweep_smoke():
+    """--bwd-grid-sweep structural smoke on the CPU backend: every combo
+    row carries the schema (block shape, pair timing, roofline fraction)
+    and flags itself as the jnp fallback; the 16k numbers come from
+    hardware (PERF.md)."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    rows = bench.run_bwd_grid_sweep("debug-tiny", seq=128, batch=1,
+                                    steps=1, blocks=[(64, 64), (128, 64)])
+    assert len(rows) == 2
+    for row in rows:
+        assert row["is_tpu_kernel"] is False
+        assert row["pair_ms"] > 0 and row["fwd_ms"] > 0
+        assert row["unit"] == "pair_fraction_of_peak"

@@ -89,9 +89,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--grad-engine", default="auto",
                    choices=["auto", "ad", "fused"],
                    help="'fused' accumulates per-layer dW in-scan (no "
-                        "per-microbatch grad tree; dense pp=cp=1 + "
-                        "remat_policy=dots_attn only); 'auto' picks it "
-                        "whenever supported")
+                        "per-microbatch grad tree; any pp=1 layout incl. "
+                        "tp/SP/cp ring|ulysses/MoE/ep, with "
+                        "remat_policy=dots_attn — see the README "
+                        "eligibility matrix); 'auto' picks it whenever "
+                        "supported")
     # dataset
     p.add_argument("--dataset", default="synthetic")
     p.add_argument("--subset", default=None)

@@ -53,6 +53,19 @@ PRESETS: dict[str, tuple[str, dict, dict]] = {
     "tiny-moe-offload": ("debug-tiny-moe", dict(ep_size=2),
                          dict(gradient_accumulation_steps=2,
                               optimizer_offload=True)),
+    # the fused grad engine on its widened axes (parallel/fused_bwd.py):
+    # the audit must see the same per-axis schedule the AD engine lowers —
+    # SP all-gather/reduce-scatter pair, cp4 ring ppermute — from the
+    # manual backward scan (collectives.py presence rules)
+    "tiny-sp-fused": ("debug-tiny",
+                      dict(dp_size=2, tp_size=2, sequence_parallel=True),
+                      dict(gradient_accumulation_steps=2,
+                           grad_engine="fused",
+                           remat_policy="dots_attn")),
+    "tiny-cp4-fused": ("debug-tiny", dict(dp_size=2, cp_size=4),
+                       dict(gradient_accumulation_steps=2,
+                            grad_engine="fused",
+                            remat_policy="dots_attn")),
 }
 
 

@@ -66,6 +66,12 @@ def main() -> None:
                     help="divide durations by N to report per-step ms")
     ap.add_argument("--device", default="TPU",
                     help="substring selecting device process rows")
+    ap.add_argument("--markdown", action="store_true",
+                    help="emit the table as GitHub markdown — the format "
+                         "PERF.md commits headline-step breakdowns in "
+                         "(capture with `bench.py --profile DIR "
+                         "--profile-steps 1`, then summarize with "
+                         "--steps 1 --markdown)")
     args = ap.parse_args()
 
     totals, procs = summarize(load_events(args.trace_dir), args.device)
@@ -74,6 +80,13 @@ def main() -> None:
     grand = sum(totals.values())
     div = args.steps or 1
     unit = "ms/step" if args.steps else "ms total"
+    if args.markdown:
+        print(f"| share | {unit} | op |")
+        print("|---|---|---|")
+        for name, d in totals.most_common(args.top):
+            print(f"| {d / grand * 100:.1f}% | {d / 1e3 / div:.2f} "
+                  f"| `{name[:90]}` |")
+        return
     print(f"device processes: {sorted(set(procs.values()))}")
     print(f"{'share':>6}  {unit:>12}  op")
     for name, d in totals.most_common(args.top):
