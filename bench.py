@@ -104,9 +104,11 @@ def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
             remat_policy: str = "dots", adam_moments_dtype: str = "bfloat16",
             ce_chunk: int = 0, optimizer_offload: bool = False,
             profile: str | None = None,
-            profile_steps: int | None = None) -> dict:
+            profile_steps: int | None = None,
+            telemetry: str | None = None) -> dict:
     from picotron_tpu.mesh import MeshEnv
     from picotron_tpu.parallel.api import init_sharded_state, make_train_step
+    from picotron_tpu.telemetry import Histogram, JsonlSink
     from picotron_tpu.utils import device_peak_flops, flops_per_token, mfu
 
     n_chips = len(jax.devices())
@@ -164,8 +166,30 @@ def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
     peak = device_peak_flops()
     mfu_frac = mfu(tokens_per_sec, cfg.model, seq, n_chips, peak)
 
+    # Per-step distribution: the chained timing above is the headline mean
+    # (no per-step host round-trip), but a mean hides stragglers — a second
+    # pass times each step individually with a value fetch and reports
+    # p50/p95 from the histogram registry. The per-step sync adds the
+    # host<->device transport latency to every sample, so p50 can sit a
+    # touch above the chained mean; the p95/p50 RATIO is the straggler
+    # signal. `telemetry` (``--telemetry FILE``) additionally writes every
+    # sample to the JSONL sink (one bench_step event per step +
+    # bench_summary), the same stream tools/telemetry_report.py reads.
+    hist = Histogram()
+    sink = JsonlSink(telemetry) if telemetry else None
+    for i in range(steps):
+        t0 = time.perf_counter()
+        state, metrics = step(state, batch)
+        float(metrics["loss"])  # value fetch: the step must have executed
+        dt_i = time.perf_counter() - t0
+        hist.observe(dt_i)
+        if sink is not None:
+            sink.emit({"ts": time.time(), "kind": "bench_step", "i": i,
+                       "secs": round(dt_i, 6),
+                       "tokens_per_sec": round(tokens_per_step / dt_i, 1)})
+
     layer_tag = f"-{cfg.model.num_hidden_layers}L"
-    return {
+    row = {
         "metric": f"mfu_{model.split('/')[-1]}{layer_tag}_seq{seq}",
         "value": round(mfu_frac, 4),
         "unit": "fraction_of_peak",
@@ -181,7 +205,14 @@ def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
         # harness) — `loss` trends toward memorization and says nothing
         # about model quality; see tests/test_train_e2e.py for real training.
         "loss_is_fixed_batch_memorization": True,
+        "step_time_ms_mean": round(dt / steps * 1e3, 2),
+        "step_time_ms_p50": round(hist.p50 * 1e3, 2),
+        "step_time_ms_p95": round(hist.p95 * 1e3, 2),
     }
+    if sink is not None:
+        sink.emit({"ts": time.time(), "kind": "bench_summary", **row})
+        sink.close()
+    return row
 
 
 def run_decode(model: str, layers, prompt_len: int, max_new: int,
@@ -408,6 +439,10 @@ def main() -> None:
                          "(in-flight fused-scan slices + xprof device "
                          "buffers; PERF.md). Use `--profile DIR "
                          "--profile-steps 1`.")
+    ap.add_argument("--telemetry", metavar="FILE", default=None,
+                    help="write per-step timing samples + the summary row "
+                         "to this JSONL file (picotron_tpu/telemetry sink "
+                         "schema; summarize with tools/telemetry_report.py)")
     ap.add_argument("--sweep", action="store_true",
                     help="run the breadth matrix (one JSON line per config, "
                          "headline last) instead of a single config")
@@ -486,6 +521,7 @@ def main() -> None:
                     "profile": (None, "--profile"),
                     "profile_steps": (None, "--profile-steps"),
                     "tp": (1, "--tp"),
+                    "telemetry": (None, "--telemetry"),
                     "no_remat": (False, "--no-remat")}
         clashing = [flag for k, (v, flag) in defaults.items()
                     if getattr(args, k) != v]
@@ -613,7 +649,7 @@ def main() -> None:
         remat_policy=args.remat_policy,
         adam_moments_dtype=args.adam_moments_dtype, ce_chunk=args.ce_chunk,
         optimizer_offload=args.optimizer_offload, profile=args.profile,
-        profile_steps=args.profile_steps)))
+        profile_steps=args.profile_steps, telemetry=args.telemetry)))
 
 
 if __name__ == "__main__":

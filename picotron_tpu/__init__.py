@@ -23,3 +23,4 @@ from picotron_tpu.parallel.api import (  # noqa: F401
     init_sharded_state,
     make_train_step,
 )
+from picotron_tpu.telemetry import Telemetry  # noqa: F401

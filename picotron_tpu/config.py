@@ -648,6 +648,16 @@ class LoggingConfig:
     profile_dir: Optional[str] = None
     profile_start_step: int = 3
     profile_num_steps: int = 3
+    # Structured telemetry (picotron_tpu/telemetry): write the per-host
+    # JSONL event stream — step-phase timings, goodput ledger, resilience
+    # events, per-step metrics — to `telemetry.jsonl` next to the
+    # checkpoints (telemetry_dir overrides the location). Append-mode, so
+    # a supervised restart continues the same stream and
+    # tools/telemetry_report.py can account replayed steps across
+    # restarts. The stdout log line is unaffected either way (its format
+    # is frozen; tools/extract_metrics.py parses it).
+    telemetry_jsonl: bool = True
+    telemetry_dir: Optional[str] = None
 
 
 @dataclass(frozen=True)

@@ -101,6 +101,18 @@ def _log(msg: str) -> None:
     print(f"[chaos] {msg}", file=sys.stderr, flush=True)
 
 
+def _emit(e: "ChaosEvent", point: str, step: int) -> None:
+    # Record-only telemetry (no category: the injected fault's COST is
+    # booked by whatever it disrupts — the stalled data phase, the retry
+    # backoff, the rollback — so booking the injection too would
+    # double-count). The event ties the booked badput to its cause in
+    # the JSONL stream.
+    from picotron_tpu.telemetry import bus
+
+    bus.emit("chaos", chaos_kind=e.kind, point=point, step=step,
+             fired=e.fired, count=e.count)
+
+
 class ChaosController:
     def __init__(self, events: list[ChaosEvent]):
         self.events = list(events)
@@ -134,6 +146,7 @@ class ChaosController:
                 e.fired += 1
                 _log(f"poisoning gradients at step {step} "
                      f"({e.fired}/{e.count})")
+                _emit(e, "poison_step", step)
                 return True
         return False
 
@@ -148,6 +161,7 @@ class ChaosController:
             e.fired += 1
             _log(f"firing {e.kind} at {point} step {step} "
                  f"({e.fired}/{e.count})")
+            _emit(e, point, step)
             if e.kind in ("sigterm", "sigint"):
                 os.kill(os.getpid(),
                         signal.SIGTERM if e.kind == "sigterm"

@@ -75,6 +75,13 @@ class PreemptionHandler:
             raise KeyboardInterrupt
         self.signum = signum
         self._event.set()
-        print(f"[preemption] caught {signal.Signals(signum).name}; will "
+        name = signal.Signals(signum).name
+        print(f"[preemption] caught {name}; will "
               f"finish the in-flight step, write an emergency checkpoint, "
               f"and exit {EXIT_PREEMPTED}", file=sys.stderr, flush=True)
+        # Record-only event (the drain time itself is booked by the
+        # driver's preempt-save phase). Runs in the Python-level handler
+        # between bytecodes on the main thread, so the sink write is safe.
+        from picotron_tpu.telemetry import bus
+
+        bus.emit("preempt_signal", signal=name)

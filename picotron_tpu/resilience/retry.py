@@ -22,6 +22,7 @@ from dataclasses import dataclass
 from typing import Callable, Iterator, Optional
 
 from picotron_tpu.resilience import watchdog as _watchdog
+from picotron_tpu.telemetry import bus as _telemetry
 
 
 @dataclass(frozen=True)
@@ -81,9 +82,15 @@ def retry_call(fn: Callable, *args,
             if attempt == policy.attempts:
                 raise
             delay = next(delays)
-            print(f"[retry] {describe or getattr(fn, '__name__', 'call')}: "
+            target = describe or getattr(fn, "__name__", "call")
+            print(f"[retry] {target}: "
                   f"attempt {attempt}/{policy.attempts} failed ({e!r}); "
                   f"retrying in {delay:.2f}s", file=sys.stderr, flush=True)
+            # The backoff sleep is pure badput — booked to the goodput
+            # ledger (category retry_backoff) when telemetry is installed.
+            _telemetry.emit("retry", category="retry_backoff", secs=delay,
+                            target=target, attempt=attempt,
+                            attempts=policy.attempts, error=repr(e))
             if sleep is time.sleep:
                 _heartbeat_sleep(delay)
             else:  # injected sleep (tests): hand over the whole delay

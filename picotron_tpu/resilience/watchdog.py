@@ -100,6 +100,17 @@ class Watchdog:
               f"(timeout {self.timeout:g}s); last {where} — dumping stacks "
               f"and exiting {EXIT_WATCHDOG} for supervisor restart",
               file=sys.stderr, flush=True)
+        # The hung phase never completes, so its PhaseTimer booking never
+        # happens — this event is the only accounting of the burned time.
+        # JSONL flushes per event, so it is durable before the os._exit.
+        try:
+            from picotron_tpu.telemetry import bus
+
+            bus.emit("watchdog_timeout", secs=age,
+                     category=("data_wait" if phase == "data" else "other"),
+                     phase=phase, step=step, timeout=self.timeout)
+        except Exception:  # noqa: BLE001 — the exit below must still happen
+            pass
         try:
             dump_all_stacks(sys.stderr)
         except Exception:  # noqa: BLE001 — the exit below must still happen

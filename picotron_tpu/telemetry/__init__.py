@@ -1,0 +1,221 @@
+"""Structured telemetry: registry, sinks, phase timing, goodput ledger.
+
+The observability substrate the training driver, bench harness, and
+resilience machinery report through. One `Telemetry` facade owns:
+
+- a `MetricsRegistry` (counters / gauges / p50-p95 histograms),
+- the sink fan-out — stdout (the frozen log-line format
+  tools/extract_metrics.py parses), a per-host `telemetry.jsonl` event
+  stream next to the checkpoints, and the wandb adapter (rollback-safe:
+  monotonic event counter, step as a field),
+- a `PhaseTimer` that wraps the step loop's sections AND is the
+  watchdog's heartbeat source — timing and liveness share one clock,
+- a `GoodputLedger` classifying every accounted second (compute vs
+  compile / ckpt I/O / restore+replay / preemption drain / retry backoff
+  / data stall / ...), fed by the phases and by events the resilience
+  modules emit through `telemetry.bus`,
+- a `CompileWatch` (jax.monitoring) that measures XLA compile time
+  exactly and flags unexpected re-jits of the step.
+
+Post-hoc: `tools/telemetry_report.py` summarizes a JSONL stream (goodput
+%, phase breakdown, event counts) for run triage; the per-phase category
+mapping is shared so in-process and post-hoc accounting agree.
+
+JSONL schema (one object per line; `ts` = time.time()):
+
+  {"ts", "kind": "phase", "phase", "step", "secs", "category"}
+  {"ts", "kind": "step",  "step", "loss", "tokens_per_sec",
+   "tokens_per_sec_per_chip", "mfu", "trained_tokens", "memory_gb", ...}
+  {"ts", "kind": "eval",  "step", "val_loss"}
+  {"ts", "kind": <event>, ...}        # retry / chaos / guard / preempt /
+                                      # recompile / watchdog_timeout ...
+  {"ts", "kind": "run_summary", "goodput": {...}, "metrics": {...}}
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from picotron_tpu.telemetry import bus
+from picotron_tpu.telemetry.goodput import (
+    CATEGORIES, GOODPUT_CATEGORIES, PHASE_CATEGORY, GoodputLedger,
+)
+from picotron_tpu.telemetry.phases import PhaseTimer
+from picotron_tpu.telemetry.recompile import CompileWatch
+from picotron_tpu.telemetry.registry import (
+    Counter, Gauge, Histogram, MetricsRegistry,
+)
+from picotron_tpu.telemetry.sinks import (
+    JsonlSink, Sink, StdoutSink, WandbSink, telemetry_jsonl_path,
+)
+
+__all__ = [
+    "CATEGORIES",
+    "GOODPUT_CATEGORIES",
+    "PHASE_CATEGORY",
+    "CompileWatch",
+    "Counter",
+    "Gauge",
+    "GoodputLedger",
+    "Histogram",
+    "JsonlSink",
+    "MetricsRegistry",
+    "PhaseTimer",
+    "Sink",
+    "StdoutSink",
+    "Telemetry",
+    "WandbSink",
+    "bus",
+    "telemetry_jsonl_path",
+]
+
+
+class Telemetry:
+    """Facade wiring registry + sinks + phases + ledger + compile watch.
+
+    Constructed once per run (train.main / bench), installed on the bus so
+    library code reaches it, closed in the driver's teardown (writes the
+    run_summary event). Sinks may be attached late (wandb initializes
+    after the config banner; the watchdog after the resilience block) —
+    everything else works from the first emitted event.
+    """
+
+    def __init__(self, sinks: Optional[list] = None, watchdog=None,
+                 compile_watch: Optional[CompileWatch] = None):
+        self.registry = MetricsRegistry()
+        self.ledger = GoodputLedger()
+        self.sinks: list = list(sinks or [])
+        self.compile_watch = (compile_watch if compile_watch is not None
+                              else CompileWatch().install())
+        self.phases = PhaseTimer(self._phase_done, watchdog=watchdog,
+                                 on_enter=self._phase_enter)
+        self._step_phases_done = 0
+        self._closed = False
+        # Anchor the stream's wall-clock: compiles/setup before the first
+        # phase would otherwise make the report's `accounted` exceed its
+        # observed `wall`.
+        self._fan_out({"ts": time.time(), "kind": "run_start"})
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_config(cls, cfg, watchdog=None) -> "Telemetry":
+        import jax  # local: keep the package importable without a backend
+
+        is_primary = jax.process_index() == 0
+        sinks: list = [StdoutSink(is_primary=is_primary)]
+        path = telemetry_jsonl_path(cfg, jax.process_index())
+        if path is not None:
+            sinks.append(JsonlSink(path))
+        return cls(sinks=sinks, watchdog=watchdog)
+
+    def attach_watchdog(self, watchdog) -> None:
+        self.phases.watchdog = watchdog
+
+    def attach_wandb(self, run) -> "WandbSink":
+        sink = WandbSink(run)
+        self.sinks.append(sink)
+        return sink
+
+    @property
+    def jsonl_path(self) -> Optional[str]:
+        for s in self.sinks:
+            if isinstance(s, JsonlSink):
+                return s.path
+        return None
+
+    # -- event plumbing ----------------------------------------------------
+
+    def emit(self, kind: str, *, category: Optional[str] = None,
+             secs: Optional[float] = None, book: bool = True,
+             **fields) -> None:
+        """Emit one event. `category` + `secs` book the time into the
+        goodput ledger unless `book=False` (phase events arrive already
+        booked by book_phase — re-booking would double-count)."""
+        self.registry.counter(f"events/{kind}").inc()
+        if book and category is not None and secs is not None:
+            self.ledger.book(category, secs)
+        event = {"ts": time.time(), "kind": kind, **fields}
+        if category is not None:
+            event["category"] = category
+        if secs is not None:
+            event["secs"] = round(secs, 6)
+        self._fan_out(event)
+
+    def _fan_out(self, event: dict) -> None:
+        for sink in self.sinks:
+            try:
+                sink.emit(event)
+            except Exception:  # noqa: BLE001 — a sick sink must not kill a step
+                pass
+
+    def _phase_enter(self, name: str, step) -> None:
+        """Drain compiles that accrued OUTSIDE any phase (jit init /
+        warm-up between loop sections) before this phase's clock starts —
+        left in the accumulator they would be drained at this phase's END
+        and clamped against its wall, silently eating the phase (the
+        sigterm-resume restore was booked as 0 this way)."""
+        n_compiles, compile_secs = self.compile_watch.drain()
+        if n_compiles:
+            self.registry.counter("compile/count").inc(n_compiles)
+            self.emit("compile", category="compile", secs=compile_secs,
+                      phase=None, step=step, compiles=n_compiles)
+
+    def _phase_done(self, name: str, secs: float, step) -> None:
+        """PhaseTimer callback: drain exact compile time, book the ledger,
+        feed the histograms, emit the phase event(s). The phase event's
+        `secs` carries the NON-compile remainder and the compile share
+        rides its own category="compile" event, so a post-hoc sum of
+        (category, secs) pairs over the JSONL reproduces the ledger."""
+        n_compiles, compile_secs = self.compile_watch.drain()
+        compile_secs = min(compile_secs, max(secs, 0.0))
+        category = self.ledger.book_phase(name, secs, step=step,
+                                          compile_secs=compile_secs)
+        self.registry.histogram(f"phase/{name}").observe(secs)
+        if n_compiles:
+            self.registry.counter("compile/count").inc(n_compiles)
+            self.emit("compile", category="compile", secs=compile_secs,
+                      book=False, phase=name, step=step,
+                      compiles=n_compiles)
+            if name == "step" and self._step_phases_done > 0:
+                # Re-jit of an already-compiled step: shape/dtype/weak-type
+                # drift — exactly what analysis/hazards.py lints statically.
+                self.registry.counter("compile/unexpected_recompiles").inc(
+                    n_compiles)
+                self.emit("recompile", step=step, compiles=n_compiles,
+                          compile_secs=round(compile_secs, 6))
+        if name == "step":
+            self._step_phases_done += 1
+        self.emit("phase", category=category, secs=secs - compile_secs,
+                  book=False, phase=name, step=step)
+
+    # -- step / eval records ----------------------------------------------
+
+    def record_step(self, step: int, line: str, **fields) -> None:
+        """One training-log record: the preformatted console `line` goes to
+        stdout byte-identically; the structured fields go to JSONL/wandb."""
+        self._fan_out({"ts": time.time(), "kind": "step", "step": step,
+                       "line": line, **fields})
+
+    def record_eval(self, step: int, val_loss: float, line: str) -> None:
+        self._fan_out({"ts": time.time(), "kind": "eval", "step": step,
+                       "val_loss": val_loss, "line": line})
+
+    # -- teardown ----------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._fan_out({"ts": time.time(), "kind": "run_summary",
+                       "goodput": self.ledger.summary(),
+                       "metrics": self.registry.snapshot()})
+        self.compile_watch.uninstall()
+        for sink in self.sinks:
+            try:
+                sink.close()
+            except Exception:  # noqa: BLE001
+                pass
+        if bus.active() is self:
+            bus.install(None)

@@ -1,0 +1,38 @@
+"""Module-level event bus — how library code reaches telemetry without
+plumbing.
+
+Same arrangement as resilience.chaos: checkpoint/data/resilience code
+calls `bus.emit(...)` unconditionally; with no Telemetry installed (unit
+tests, library use) the call is a None check and nothing else. train.main
+installs the run's Telemetry, after which every emitted event reaches the
+sinks and — when it carries `category` + `secs` — the goodput ledger.
+
+Events from background threads (watchdog fire, retry backoff) are safe:
+the JSONL sink locks, and ledger booking is a dict add under the GIL.
+"""
+
+from __future__ import annotations
+
+_active = None
+
+
+def install(telemetry):
+    """Make `telemetry` the process-wide event target (None uninstalls).
+    Returns it for chaining."""
+    global _active
+    _active = telemetry
+    return telemetry
+
+
+def active():
+    return _active
+
+
+def emit(kind: str, *, category: str | None = None,
+         secs: float | None = None, **fields) -> None:
+    """Emit one event. `category` + `secs` additionally book the time into
+    the goodput ledger (e.g. retry backoff sleeps); bare events are
+    record-only (chaos firings, guard trips, preemption signals)."""
+    t = _active
+    if t is not None:
+        t.emit(kind, category=category, secs=secs, **fields)

@@ -1,0 +1,119 @@
+"""Goodput/badput ledger: where did the wall-clock actually go?
+
+Every timed second of the run is booked to exactly one category:
+
+- ``compute``      — the jitted train step doing productive work. The ONLY
+                     goodput category: goodput% = compute / accounted.
+- ``compile``      — XLA compilation (measured exactly via the
+                     jax.monitoring backend-compile hook, subtracted from
+                     whichever phase it occurred inside).
+- ``replay``       — re-training steps at-or-below the high-water mark:
+                     after a divergence-guard rollback those steps ran
+                     before, so their time buys back lost ground, not new
+                     progress.
+- ``restore``      — checkpoint restore (rollback or resume).
+- ``ckpt_io``      — periodic checkpoint saves.
+- ``preempt``      — preemption drain: the emergency save between SIGTERM
+                     and exit 75.
+- ``retry_backoff``— sleeps between I/O retry attempts.
+- ``data_wait``    — the step loop blocked on the data producer (covers
+                     injected/real data stalls).
+- ``host_sync``    — device->host metric fetch for guards/logging.
+- ``eval``         — validation passes.
+- ``other``        — anything booked without a better class.
+
+The per-phase -> category mapping is shared with tools/telemetry_report.py
+(PHASE_CATEGORY) so in-process booking and post-hoc JSONL analysis can
+never disagree. Badput sources that KILL the process mid-phase (watchdog
+stall, hard crash) never complete a phase, so their time shows up in the
+report's `unaccounted` bucket (wall - accounted) plus the explicit
+watchdog/stall events — the ledger only books what it observed end-to-end.
+"""
+
+from __future__ import annotations
+
+GOODPUT_CATEGORIES = ("compute",)
+
+# Step-loop phase name -> ledger category. "step" is special-cased in
+# book_phase (compute vs replay vs compile split); everything else maps
+# statically. Shared with tools/telemetry_report.py.
+PHASE_CATEGORY = {
+    "data": "data_wait",
+    "step": "compute",
+    "sync": "host_sync",
+    "eval": "eval",
+    "save": "ckpt_io",
+    "rollback": "restore",
+    "restore": "restore",
+    "preempt-save": "preempt",
+}
+
+CATEGORIES = (
+    "compute", "compile", "replay", "restore", "ckpt_io", "preempt",
+    "retry_backoff", "data_wait", "host_sync", "eval", "other",
+)
+
+
+class GoodputLedger:
+    def __init__(self) -> None:
+        self.seconds: dict[str, float] = {}
+        # Highest step whose "step" phase completed: a later booking at or
+        # below it is re-training after a rollback -> replay, not compute.
+        self.high_water_step = 0
+
+    def book(self, category: str, secs: float) -> None:
+        if secs <= 0:
+            return
+        if category not in CATEGORIES:
+            category = "other"
+        self.seconds[category] = self.seconds.get(category, 0.0) + secs
+
+    def book_phase(self, phase: str, secs: float, step=None,
+                   compile_secs: float = 0.0) -> str:
+        """Book one completed phase; returns the category the NON-compile
+        remainder was booked under (what the phase event should carry).
+        `compile_secs` is the exactly-measured XLA compile time that
+        occurred inside this phase (recompile.CompileWatch) — booked as
+        `compile` and subtracted, so step 1's wall does not masquerade as
+        productive compute."""
+        compile_secs = min(max(compile_secs, 0.0), max(secs, 0.0))
+        if compile_secs:
+            self.book("compile", compile_secs)
+            secs -= compile_secs
+        category = PHASE_CATEGORY.get(phase, "other")
+        if phase == "step" and step is not None:
+            if step <= self.high_water_step:
+                category = "replay"
+            else:
+                self.high_water_step = step
+        self.book(category, secs)
+        return category
+
+    def resume_from(self, step: int) -> None:
+        """Seed the high-water mark on an in-process restore (build_state
+        resume): the restored step count is ground already covered."""
+        self.high_water_step = max(self.high_water_step, int(step))
+
+    @property
+    def accounted(self) -> float:
+        return sum(self.seconds.values())
+
+    @property
+    def goodput_seconds(self) -> float:
+        return sum(self.seconds.get(c, 0.0) for c in GOODPUT_CATEGORIES)
+
+    def goodput_fraction(self):
+        total = self.accounted
+        return (self.goodput_seconds / total) if total > 0 else None
+
+    def summary(self) -> dict:
+        frac = self.goodput_fraction()
+        return {
+            "accounted_seconds": round(self.accounted, 6),
+            "goodput_seconds": round(self.goodput_seconds, 6),
+            "goodput_pct": (round(100.0 * frac, 2)
+                            if frac is not None else None),
+            "seconds_by_category": {
+                k: round(v, 6) for k, v in sorted(self.seconds.items())},
+            "high_water_step": self.high_water_step,
+        }

@@ -1,0 +1,87 @@
+"""Recompile detection via jax.monitoring: exact compile-time accounting
+plus a tripwire for unexpected re-jits of the train step.
+
+XLA compilation is invisible to wall-clock phase timing (it just makes
+step 1 — or, worse, a silently-recompiling step N — slow). jax.monitoring
+publishes `/jax/core/compile/backend_compile_duration` for every backend
+compile, so a registered listener measures compile time EXACTLY instead of
+guessing from step-time outliers. The Telemetry facade drains the
+accumulator at every phase boundary: the drained seconds are booked to the
+`compile` goodput category (subtracted from the enclosing phase), and any
+compile observed in a "step" phase after the first flags an unexpected
+recompile — the classic symptoms being a shape-dtype drift or a weak-type
+mismatch that shardcheck's hazard pass exists to catch statically.
+
+jax.monitoring has no per-listener unregister (only a global clear), so
+one module-level listener registers lazily on first install and routes to
+whichever watch is currently active; inactive = zero overhead beyond a
+None check.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_active: "CompileWatch | None" = None
+_registered = False
+_register_lock = threading.Lock()
+
+
+def _listener(name: str, secs: float, **kw) -> None:
+    watch = _active
+    if watch is not None and name == _COMPILE_EVENT:
+        watch._record(secs)
+
+
+def _ensure_registered() -> bool:
+    global _registered
+    with _register_lock:
+        if _registered:
+            return True
+        try:
+            import jax.monitoring
+
+            jax.monitoring.register_event_duration_secs_listener(_listener)
+        except Exception:  # noqa: BLE001 — jax too old / stripped build
+            return False
+        _registered = True
+        return True
+
+
+class CompileWatch:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._count = 0
+        self._secs = 0.0
+        self.total_count = 0
+        self.total_secs = 0.0
+        self.supported = False
+
+    def install(self) -> "CompileWatch":
+        global _active
+        self.supported = _ensure_registered()
+        _active = self
+        return self
+
+    def uninstall(self) -> None:
+        global _active
+        if _active is self:
+            _active = None
+
+    def _record(self, secs: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._secs += secs
+            self.total_count += 1
+            self.total_secs += secs
+
+    def drain(self) -> tuple[int, float]:
+        """(compiles, seconds) since the previous drain — called at each
+        phase boundary so compile time lands in the phase it occurred in."""
+        with self._lock:
+            out = (self._count, self._secs)
+            self._count = 0
+            self._secs = 0.0
+        return out

@@ -1,0 +1,126 @@
+"""Pluggable telemetry sinks: stdout (frozen format), JSONL, wandb.
+
+Every sink receives the same event dicts from the Telemetry facade and
+serializes what it cares about:
+
+- ``StdoutSink`` — the existing per-step console line. Its format is a
+  de-facto API (tools/extract_metrics.py regex-parses it, same contract
+  the reference has between train.py prints and its extract_metrics);
+  the line arrives PREFORMATTED by utils.training_log_line so routing
+  through telemetry cannot perturb a byte of it.
+- ``JsonlSink`` — one JSON object per line, append-mode (a supervised
+  restart into the same save_dir continues the same stream — that is how
+  tools/telemetry_report.py sees replayed steps across restarts). Flushed
+  per event: the interesting events are exactly the ones right before a
+  crash/exit. Thread-safe (the watchdog/retry threads emit too).
+- ``WandbSink`` — the wandb adapter. wandb silently DROPS log(step=...)
+  calls whose step is lower than one already logged, so every point after
+  a divergence-guard rollback would vanish from the dashboard. The sink
+  therefore logs against its own monotonic event counter and carries the
+  training step as an ordinary field, additionally `define_metric`-ing
+  "step" as the x-axis where the wandb version supports it — both halves
+  of the fix, so charts stay step-indexed AND post-rollback points
+  survive.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from typing import Optional
+
+
+class Sink:
+    def emit(self, event: dict) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class StdoutSink(Sink):
+    """Prints preformatted console lines (events carrying a "line" field)
+    from the logging host only — the same process gate utils.log_print
+    applies, passed in so this module stays jax-free."""
+
+    def __init__(self, is_primary: bool = True):
+        self.is_primary = is_primary
+
+    def emit(self, event: dict) -> None:
+        line = event.get("line")
+        if line is not None and self.is_primary:
+            print(line)
+            sys.stdout.flush()
+
+
+class JsonlSink(Sink):
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._f = open(path, "a")
+
+    def emit(self, event: dict) -> None:
+        # "line" is stdout presentation, not data — the structured fields
+        # carry strictly more information.
+        rec = {k: v for k, v in event.items() if k != "line"}
+        with self._lock:
+            if self._f.closed:
+                return
+            self._f.write(json.dumps(rec) + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+
+# Event kinds a wandb dashboard wants as chart points; everything else
+# (phase timings, chaos/retry bookkeeping) stays in the JSONL stream.
+_WANDB_KINDS = ("step", "eval")
+
+
+class WandbSink(Sink):
+    def __init__(self, run):
+        self.run = run
+        self._seq = 0  # monotonic wandb step axis; never rewinds
+        try:
+            # Preferred fix where available: make the "step" FIELD the
+            # x-axis for every metric, so charts read in training steps.
+            run.define_metric("step")
+            run.define_metric("*", step_metric="step")
+        except Exception:  # noqa: BLE001 — older wandb / fake runs
+            pass
+
+    def emit(self, event: dict) -> None:
+        if event.get("kind") not in _WANDB_KINDS:
+            return
+        data = {k: v for k, v in event.items()
+                if k not in ("kind", "ts", "line") and v is not None}
+        self._seq += 1
+        self.run.log(data, step=self._seq)
+
+    def close(self) -> None:
+        try:
+            self.run.finish()
+        except Exception as e:  # noqa: BLE001 — mirror train.py's old fence
+            print(f"wandb finish failed during shutdown: {e!r}",
+                  file=sys.stderr)
+
+
+def telemetry_jsonl_path(cfg, process_index: int = 0) -> Optional[str]:
+    """Resolve the per-host JSONL path for a run config, or None when
+    disabled. Process 0 owns the canonical `telemetry.jsonl` (next to the
+    checkpoints, so run artifacts travel together); other hosts of a
+    multi-process run write `telemetry.p<idx>.jsonl` beside it."""
+    import os
+
+    lg = cfg.logging
+    if not lg.telemetry_jsonl:
+        return None
+    base = lg.telemetry_dir or cfg.checkpoint.save_dir
+    os.makedirs(base, exist_ok=True)
+    name = ("telemetry.jsonl" if process_index == 0
+            else f"telemetry.p{process_index}.jsonl")
+    return os.path.join(base, name)

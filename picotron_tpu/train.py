@@ -19,6 +19,12 @@ hung step or stalled producer into a stack dump + exit 77 instead of a
 silently burning reservation. All of it is testable on CPU via the chaos
 harness (PICOTRON_CHAOS / resilience.chaos; tools/chaos.py runs whole
 fault-recovery scenarios). See README "Fault tolerance".
+
+Observability: the loop reports through picotron_tpu/telemetry — the
+frozen stdout line, a per-host telemetry.jsonl event stream (step-phase
+timings, goodput/badput ledger, resilience events, exact compile time),
+and a rollback-safe wandb adapter; tools/telemetry_report.py summarizes a
+stream post-hoc. See README "Observability".
 """
 
 from __future__ import annotations
@@ -41,6 +47,7 @@ from picotron_tpu.resilience import (
     EXIT_DIVERGED, EXIT_PREEMPTED, DivergenceGuard, GuardAction,
     PreemptionHandler, Watchdog, chaos,
 )
+from picotron_tpu.telemetry import Telemetry, bus as telemetry_bus
 from picotron_tpu.train_step import TrainState
 from picotron_tpu.utils import (
     StepTimer, device_memory_gb, device_peak_flops, human_format,
@@ -48,7 +55,7 @@ from picotron_tpu.utils import (
 )
 
 
-def build_state(cfg: Config, menv: MeshEnv) \
+def build_state(cfg: Config, menv: MeshEnv, tel: Telemetry = None) \
         -> tuple[TrainState, int, int, dict, str]:
     """(state, start_step, trained_tokens, ckpt_meta, resumed_from) — fresh
     init, HF weights, or resume, in the reference's precedence (ref:
@@ -79,7 +86,11 @@ def build_state(cfg: Config, menv: MeshEnv) \
     if load_dir:
         if mgr is None:
             mgr = CheckpointManager(cfg, menv, directory=load_dir)
-        state, meta = mgr.restore(state)
+        if tel is not None:
+            with tel.phases.phase("restore"):
+                state, meta = mgr.restore(state)
+        else:
+            state, meta = mgr.restore(state)
         tokens = meta.get("trained_tokens", 0)
         log_print(f"resumed from {load_dir} at step "
                   f"{int(state.step)} ({human_format(tokens)} tokens)")
@@ -185,9 +196,21 @@ def main(argv=None) -> None:
         f"{human_format(cfg.tokens_per_step)} tokens/step"
     )
 
+    # Structured telemetry (picotron_tpu/telemetry; README
+    # "Observability"): metrics registry + sinks (the frozen stdout line,
+    # the per-host telemetry.jsonl next to the checkpoints, wandb), the
+    # step-phase timer that doubles as the watchdog heartbeat source, the
+    # goodput/badput ledger, and exact compile-time accounting. Installed
+    # on the bus BEFORE the dataloader/state build so restore retries and
+    # chaos events are captured from the first second.
+    tel = telemetry_bus.install(Telemetry.from_config(cfg))
+    if tel.jsonl_path:
+        log_print(f"telemetry -> {tel.jsonl_path}")
+
     dl = MicroBatchDataLoader(cfg, menv)
     (state, start_step, trained_tokens, ckpt_meta,
-     resumed_from) = build_state(cfg, menv)
+     resumed_from) = build_state(cfg, menv, tel)
+    tel.ledger.resume_from(start_step)
     if start_step > 0:
         # Fast-forward the dataloader so resume does not replay consumed
         # data (ADVICE r1). Checkpoints record the exact position; for ones
@@ -226,6 +249,11 @@ def main(argv=None) -> None:
             wandb_run = wandb.init(project=cfg.logging.project_name,
                                    name=cfg.logging.run_name,
                                    config=cfg.to_json_dict())
+            # The sink logs against a monotonic event counter with the
+            # training step as a field (+ define_metric'd step axis):
+            # wandb silently drops non-monotonic step= calls, which used
+            # to erase every point after a guard rollback.
+            tel.attach_wandb(wandb_run)
         except Exception as e:  # wandb optional; zero-egress pods have none
             log_print(f"wandb unavailable ({e}); continuing without")
 
@@ -253,6 +281,10 @@ def main(argv=None) -> None:
              if rcfg.guard_policy != "off" else None)
     preempt = PreemptionHandler()
     watchdog = Watchdog(rcfg.watchdog_timeout)
+    # One clock for liveness and timing: every phase entry below beats the
+    # watchdog AND times the section for the goodput ledger.
+    tel.attach_watchdog(watchdog)
+    ph = tel.phases
 
     timer = StepTimer()
     last_logged_step = start_step
@@ -280,13 +312,13 @@ def main(argv=None) -> None:
                     and step - start_step == prof.profile_start_step):
                 jax.profiler.start_trace(prof.profile_dir)
                 tracing = True
-            watchdog.beat("data", step)
-            batch = next(dl)
-            watchdog.beat("step", step)
-            use_poison = (poison_step_fn is not None
-                          and ctrl.poison_step(step))
-            state, metrics = (poison_step_fn if use_poison
-                              else step_fn)(state, batch)
+            with ph.phase("data", step):
+                batch = next(dl)
+            with ph.phase("step", step):
+                use_poison = (poison_step_fn is not None
+                              and ctrl.poison_step(step))
+                state, metrics = (poison_step_fn if use_poison
+                                  else step_fn)(state, batch)
             trained_tokens += cfg.tokens_per_step
             if not watchdog.started:
                 # Arm only after the first step completes: step 1 includes
@@ -303,14 +335,17 @@ def main(argv=None) -> None:
                         or step == total_steps)
             fmetrics = None
             if guard is not None or want_log:
-                watchdog.beat("sync", step)
-                fmetrics = {k: float(v) for k, v in
-                            jax.block_until_ready(metrics).items()}
+                with ph.phase("sync", step):
+                    fmetrics = {k: float(v) for k, v in
+                                jax.block_until_ready(metrics).items()}
             if guard is not None:
                 action, why = guard.observe(
                     step, fmetrics["loss"],
                     grad_norm=fmetrics.get("grad_norm"),
                     nonfinite=fmetrics.get("nonfinite"))
+                if action is not GuardAction.OK:
+                    tel.emit("guard", action=action.value, step=step,
+                             why=why)
                 if action is GuardAction.ABORT:
                     log_print(f"[guard {step:06d}] {why}; aborting "
                               f"(exit {EXIT_DIVERGED})")
@@ -329,9 +364,14 @@ def main(argv=None) -> None:
                                   f"(update suppressed in-step, optimizer "
                                   f"state preserved)")
                 elif action is GuardAction.ROLLBACK:
-                    watchdog.beat("rollback", step)
-                    state, step, trained_tokens = _rollback(
-                        ckpt_mgr, state, dl, step, trained_tokens, why)
+                    bad_step = step
+                    with ph.phase("rollback", step):
+                        state, step, trained_tokens = _rollback(
+                            ckpt_mgr, state, dl, step, trained_tokens, why)
+                    # Steps (restored, bad_step] now re-run at-or-below
+                    # the ledger's high-water mark -> booked as replay.
+                    tel.emit("rollback", step=bad_step, restored=step,
+                             why=why)
                     saved_steps.add(step)
                     last_logged_step = step
                     timer.lap()  # restart the throughput window
@@ -349,37 +389,38 @@ def main(argv=None) -> None:
                 tokens_per_sec = cfg.tokens_per_step * steps_in_window / dt
                 mfu_frac = mfu(tokens_per_sec, cfg.model, t.seq_length,
                                n_chips, peak)
+                mem_gb = device_memory_gb()
                 line = training_log_line(
                     step, loss, tokens_per_sec, tokens_per_sec / n_chips,
-                    mfu_frac, trained_tokens, device_memory_gb(),
+                    mfu_frac, trained_tokens, mem_gb,
                     extras=fmetrics)
-                log_print(line)
-                if wandb_run is not None:
-                    wandb_run.log({"loss": loss,
-                                   "tokens_per_sec": tokens_per_sec,
-                                   "mfu": mfu_frac,
-                                   "trained_tokens": trained_tokens,
-                                   **fmetrics},
-                                  step=step)
+                # One record, every sink: stdout gets the preformatted
+                # line byte-identically (the extract_metrics contract);
+                # JSONL/wandb get the structured fields.
+                tel.record_step(
+                    step, line, loss=loss, tokens_per_sec=tokens_per_sec,
+                    tokens_per_sec_per_chip=tokens_per_sec / n_chips,
+                    mfu=mfu_frac, trained_tokens=trained_tokens,
+                    memory_gb=mem_gb, **fmetrics)
 
             if eval_fn is not None and (step % t.eval_frequency == 0
                                         or step == total_steps):
-                watchdog.beat("eval", step)
-                # max(1, ...) guards the division alongside config.py's
-                # eval_steps >= 1 validation (defense in depth: a custom
-                # driver could hand-build a Config bypassing validate()).
-                val = sum(float(eval_fn(state.params, b))
-                          for b in eval_batches) / max(1, len(eval_batches))
-                log_print(f"[eval  {step:06d}] val_loss: {val:.4f} "
-                          f"({t.eval_steps} batches)")
-                if wandb_run is not None:
-                    wandb_run.log({"val_loss": val}, step=step)
+                with ph.phase("eval", step):
+                    # max(1, ...) guards the division alongside config.py's
+                    # eval_steps >= 1 validation (defense in depth: a custom
+                    # driver could hand-build a Config bypassing validate()).
+                    val = (sum(float(eval_fn(state.params, b))
+                               for b in eval_batches)
+                           / max(1, len(eval_batches)))
+                tel.record_eval(step, val,
+                                f"[eval  {step:06d}] val_loss: {val:.4f} "
+                                f"({t.eval_steps} batches)")
 
             if (ckpt_mgr is not None
                     and step % cfg.checkpoint.save_frequency == 0):
-                watchdog.beat("save", step)
-                path = ckpt_mgr.save(state, trained_tokens,
-                                     dataloader_state=dl.state)
+                with ph.phase("save", step):
+                    path = ckpt_mgr.save(state, trained_tokens,
+                                         dataloader_state=dl.state)
                 saved_steps.add(step)
                 log_print(f"saved checkpoint -> {path}")
 
@@ -387,10 +428,11 @@ def main(argv=None) -> None:
                 # The in-flight step finished above; make it durable and
                 # hand control back to the supervisor with the distinct
                 # exit code auto_resume pairs with.
-                watchdog.beat("preempt-save", step)
-                ckpt_mgr = _emergency_checkpoint(
-                    cfg, menv, ckpt_mgr, state, trained_tokens, dl,
-                    saved_steps)
+                with ph.phase("preempt-save", step):
+                    ckpt_mgr = _emergency_checkpoint(
+                        cfg, menv, ckpt_mgr, state, trained_tokens, dl,
+                        saved_steps)
+                tel.emit("preempted", step=step)
                 log_print(f"preempted at step {step}; state is durable — "
                           f"exiting {EXIT_PREEMPTED} for auto_resume")
                 exit_code = EXIT_PREEMPTED
@@ -404,7 +446,9 @@ def main(argv=None) -> None:
             # same-numbered checkpoint from an earlier run into the same
             # save_dir cannot suppress the save.
             if ckpt_mgr is not None and int(state.step) not in saved_steps:
-                ckpt_mgr.save(state, trained_tokens, dataloader_state=dl.state)
+                with ph.phase("save", int(state.step)):
+                    ckpt_mgr.save(state, trained_tokens,
+                                  dataloader_state=dl.state)
     finally:
         # Always-run teardown: a mid-run crash must not leak the producer
         # thread, a half-written async checkpoint, an open trace, or a
@@ -430,11 +474,14 @@ def main(argv=None) -> None:
             dl.close()
         except Exception as e:  # noqa: BLE001
             log_print(f"dataloader close failed during shutdown: {e!r}")
-        if wandb_run is not None:
-            try:
-                wandb_run.finish()
-            except Exception as e:  # noqa: BLE001
-                log_print(f"wandb finish failed during shutdown: {e!r}")
+        # Writes the run_summary event (goodput ledger + metric snapshot),
+        # closes the JSONL stream, finishes wandb (WandbSink.close), and
+        # uninstalls the bus so a crashed run cannot leak a sink into the
+        # next in-process run (tests).
+        try:
+            tel.close()
+        except Exception as e:  # noqa: BLE001
+            log_print(f"telemetry close failed during shutdown: {e!r}")
     if exit_code is not None:
         raise SystemExit(exit_code)
     log_print("training done")

@@ -544,12 +544,58 @@ def test_chaos_cli_lists_every_scenario(capsys):
         assert name in out
 
 
+# Per-scenario telemetry assertions: the injected fault's cost must be
+# BOOKED — as the named badput categories in the goodput ledger and/or as
+# the named event kinds in the stream (picotron_tpu/telemetry; the
+# telemetry half of the acceptance criteria).
+_SCENARIO_TELEMETRY = {
+    "sigterm": {"badput": ["preempt", "restore"],
+                "events": ["chaos", "preempt_signal", "preempted"]},
+    "ckpt_io": {"badput": ["retry_backoff"],
+                "events": ["chaos", "retry"]},
+    "nan_skip": {"badput": [], "events": ["chaos", "guard"]},
+    "nan_rollback": {"badput": ["restore", "replay"],
+                     "events": ["chaos", "guard", "rollback"]},
+    # the hung data phase never completes, so the stall time reaches the
+    # ledger via the watchdog's own timeout event (category data_wait)
+    "data_stall": {"badput": ["restore", "data_wait"],
+                   "events": ["chaos", "watchdog_timeout"]},
+}
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("scenario", [
     "sigterm", "ckpt_io", "nan_skip", "nan_rollback", "data_stall"])
 def test_chaos_scenario_recovers_to_baseline(tmp_path, scenario):
-    """The acceptance contract: under each injected failure the supervised
-    run ends at the same final step and trained_tokens as a fault-free
-    baseline — the failure cost restarts, not training progress."""
+    """The acceptance contract, both halves: under each injected failure
+    the supervised run (a) ends at the same final step and trained_tokens
+    as a fault-free baseline — the failure cost restarts, not training
+    progress — and (b) leaves a telemetry.jsonl from which
+    tools/telemetry_report.py reproduces the run's step count and books
+    the injected fault's cost as badput."""
+    import importlib.util
+
     cli = _load_chaos_cli()
     assert cli.run_scenario(scenario, str(tmp_path))
+
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report", os.path.join(os.path.dirname(__file__), "..",
+                                         "tools", "telemetry_report.py"))
+    rep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rep)
+    stream = os.path.join(tmp_path, "fault", "ckpt", "telemetry.jsonl")
+    s = rep.summarize(rep.load_events(stream))
+    # every scenario recovers to the full fault-free step count, and the
+    # stream (appended across supervised restarts) shows each step trained
+    assert s["steps"]["count"] == cli.STEPS
+    assert s["steps"]["max"] == cli.STEPS
+    expect = _SCENARIO_TELEMETRY[scenario]
+    for cat in expect["badput"]:
+        assert s["categories"].get(cat, 0.0) > 0, \
+            f"{scenario}: badput category {cat!r} not booked: " \
+            f"{s['categories']}"
+    for kind in expect["events"]:
+        assert s["events"].get(kind, 0) > 0, \
+            f"{scenario}: event {kind!r} absent: {s['events']}"
+    if scenario == "nan_rollback":
+        assert s["steps"]["replayed"] > 0  # re-trained ground is counted

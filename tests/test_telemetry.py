@@ -1,0 +1,578 @@
+"""Telemetry subsystem tests: registry instruments, sinks (incl. the
+rollback-safe wandb adapter), the step-phase timer / watchdog coupling,
+the goodput ledger (replay high-water mark, exact compile split), the
+recompile hook, the bus, the frozen stdout log-line contract, and the
+post-hoc report tool. The whole-run chaos assertions live with the
+scenario tests in test_resilience.py (slow tier)."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+from picotron_tpu.telemetry import (
+    CompileWatch, GoodputLedger, Histogram, JsonlSink, MetricsRegistry,
+    PhaseTimer, StdoutSink, Telemetry, WandbSink, bus,
+    telemetry_jsonl_path,
+)
+
+
+def load_report():
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report", os.path.join(os.path.dirname(__file__), "..",
+                                         "tools", "telemetry_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_basics():
+    r = MetricsRegistry()
+    r.counter("events/retry").inc()
+    r.counter("events/retry").inc(2)
+    assert r.counter("events/retry").value == 3
+    r.gauge("tokens").set(512)
+    assert r.gauge("tokens").value == 512.0
+    h = r.histogram("step")
+    for v in (1.0, 2.0, 3.0, 4.0):
+        h.observe(v)
+    assert h.count == 4 and h.sum == 10.0
+    assert h.min == 1.0 and h.max == 4.0 and h.mean == 2.5
+
+
+def test_histogram_percentiles_nearest_rank():
+    h = Histogram()
+    for v in range(1, 101):  # 1..100
+        h.observe(float(v))
+    assert h.p50 == 50.0
+    assert h.p95 == 95.0
+    assert h.percentile(100) == 100.0
+    assert h.percentile(0) == 1.0
+    assert Histogram().p50 is None  # empty: no value, not a crash
+
+
+def test_histogram_window_bounds_memory_but_keeps_lifetime_stats():
+    h = Histogram(window=8)
+    for v in range(100):
+        h.observe(float(v))
+    assert h.count == 100 and h.min == 0.0 and h.max == 99.0
+    # percentiles over the retention window (the recent distribution)
+    assert h.p50 >= 92.0
+
+
+def test_registry_snapshot_shape():
+    r = MetricsRegistry()
+    r.counter("a").inc()
+    r.histogram("b").observe(2.0)
+    snap = r.snapshot()
+    assert snap["counters"]["a"] == 1
+    assert snap["histograms"]["b"]["count"] == 1
+    assert snap["histograms"]["b"]["p95"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# sinks
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_sink_roundtrip_strips_line_and_appends(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    s = JsonlSink(p)
+    s.emit({"kind": "step", "step": 1, "loss": 2.5, "line": "[step ...]"})
+    s.close()
+    s2 = JsonlSink(p)  # append mode: a restart continues the stream
+    s2.emit({"kind": "step", "step": 2, "loss": 2.4})
+    s2.emit({"kind": "step", "step": 3})  # emit after close is a no-op
+    s2.close()
+    s2.emit({"kind": "step", "step": 9})
+    rows = [json.loads(ln) for ln in open(p)]
+    assert [r["step"] for r in rows] == [1, 2, 3]
+    assert "line" not in rows[0]  # presentation, not data
+
+
+def test_stdout_sink_gates_on_primary(capsys):
+    StdoutSink(is_primary=True).emit({"kind": "step", "line": "hello"})
+    StdoutSink(is_primary=False).emit({"kind": "step", "line": "nope"})
+    StdoutSink(is_primary=True).emit({"kind": "phase"})  # no line: silent
+    out = capsys.readouterr().out
+    assert out == "hello\n"
+
+
+class FakeWandbRun:
+    def __init__(self):
+        self.calls = []
+        self.defined = []
+        self.finished = False
+
+    def define_metric(self, *a, **k):
+        self.defined.append((a, k))
+
+    def log(self, data, step=None):
+        self.calls.append((data, step))
+
+    def finish(self):
+        self.finished = True
+
+
+def test_wandb_sink_survives_rollback_with_monotonic_steps():
+    """The satellite fix: wandb drops log(step=...) calls with
+    non-monotonic steps, so after a guard rollback (training step goes
+    5 -> 3) the sink must keep its OWN axis monotonic and carry the
+    training step as a field."""
+    run = FakeWandbRun()
+    sink = WandbSink(run)
+    for training_step in (1, 2, 3, 4, 5, 3, 4, 5, 6):  # rollback at 5->3
+        sink.emit({"kind": "step", "ts": 0.0, "step": training_step,
+                   "loss": 1.0, "line": "x"})
+    wandb_steps = [s for _, s in run.calls]
+    assert wandb_steps == sorted(wandb_steps)
+    assert len(set(wandb_steps)) == len(wandb_steps)  # strictly increasing
+    assert [d["step"] for d, _ in run.calls] == [1, 2, 3, 4, 5, 3, 4, 5, 6]
+    assert all("line" not in d and "ts" not in d for d, _ in run.calls)
+    # the step axis was define_metric'd where supported
+    assert (("step",), {}) in run.defined
+
+
+def test_wandb_sink_only_forwards_chart_kinds():
+    run = FakeWandbRun()
+    sink = WandbSink(run)
+    sink.emit({"kind": "phase", "phase": "step", "secs": 0.1})
+    sink.emit({"kind": "retry", "secs": 1.0})
+    sink.emit({"kind": "eval", "step": 4, "val_loss": 3.2})
+    assert len(run.calls) == 1
+    assert run.calls[0][0] == {"step": 4, "val_loss": 3.2}
+    sink.close()
+    assert run.finished
+
+
+def test_telemetry_jsonl_path_per_host(tmp_path):
+    from picotron_tpu.config import Config, CheckpointConfig, LoggingConfig
+
+    cfg = Config(checkpoint=CheckpointConfig(save_dir=str(tmp_path / "ck")))
+    assert telemetry_jsonl_path(cfg, 0).endswith("ck/telemetry.jsonl")
+    assert telemetry_jsonl_path(cfg, 2).endswith("ck/telemetry.p2.jsonl")
+    off = Config(logging=LoggingConfig(telemetry_jsonl=False))
+    assert telemetry_jsonl_path(off, 0) is None
+    redirected = Config(logging=LoggingConfig(
+        telemetry_dir=str(tmp_path / "elsewhere")))
+    assert telemetry_jsonl_path(redirected, 0).endswith(
+        "elsewhere/telemetry.jsonl")
+
+
+# ---------------------------------------------------------------------------
+# phase timer + watchdog coupling
+# ---------------------------------------------------------------------------
+
+
+class FakeWatchdog:
+    def __init__(self):
+        self.beats = []
+
+    def beat(self, phase, step=None):
+        self.beats.append((phase, step))
+
+
+def test_phase_timer_beats_watchdog_and_reports_duration():
+    done = []
+    wd = FakeWatchdog()
+    timer = PhaseTimer(lambda n, s, st: done.append((n, s, st)),
+                       watchdog=wd)
+    with timer.phase("data", 3):
+        pass
+    assert wd.beats == [("data", 3)]  # beat on ENTRY, before the work
+    assert len(done) == 1
+    name, secs, step = done[0]
+    assert name == "data" and step == 3 and secs >= 0
+
+
+def test_phase_timer_books_even_on_exception():
+    done = []
+    timer = PhaseTimer(lambda n, s, st: done.append(n))
+    with pytest.raises(RuntimeError):
+        with timer.phase("save", 1):
+            raise RuntimeError("boom")
+    assert done == ["save"]
+
+
+# ---------------------------------------------------------------------------
+# goodput ledger
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_phase_categories_and_goodput_fraction():
+    led = GoodputLedger()
+    assert led.book_phase("data", 1.0, step=1) == "data_wait"
+    assert led.book_phase("step", 6.0, step=1) == "compute"
+    assert led.book_phase("save", 2.0, step=1) == "ckpt_io"
+    assert led.book_phase("sync", 1.0, step=1) == "host_sync"
+    assert led.goodput_fraction() == pytest.approx(0.6)
+    s = led.summary()
+    assert s["goodput_pct"] == 60.0
+    assert s["seconds_by_category"]["ckpt_io"] == 2.0
+
+
+def test_ledger_books_replay_below_high_water_mark():
+    """Steps re-trained after a rollback buy back lost ground — badput."""
+    led = GoodputLedger()
+    for step in (1, 2, 3, 4):
+        assert led.book_phase("step", 1.0, step=step) == "compute"
+    # rollback to 2: steps 3 and 4 re-run, then new ground at 5
+    assert led.book_phase("rollback", 0.5, step=4) == "restore"
+    assert led.book_phase("step", 1.0, step=3) == "replay"
+    assert led.book_phase("step", 1.0, step=4) == "replay"
+    assert led.book_phase("step", 1.0, step=5) == "compute"
+    assert led.seconds["replay"] == 2.0
+    assert led.seconds["compute"] == 5.0
+
+
+def test_ledger_resume_seeds_high_water_mark():
+    led = GoodputLedger()
+    led.resume_from(10)
+    assert led.book_phase("step", 1.0, step=10) == "replay"
+    assert led.book_phase("step", 1.0, step=11) == "compute"
+
+
+def test_ledger_compile_split_subtracts_from_phase():
+    led = GoodputLedger()
+    cat = led.book_phase("step", 10.0, step=1, compile_secs=8.0)
+    assert cat == "compute"
+    assert led.seconds["compile"] == 8.0
+    assert led.seconds["compute"] == pytest.approx(2.0)
+    # compile can never exceed the observed phase wall
+    led2 = GoodputLedger()
+    led2.book_phase("step", 1.0, step=1, compile_secs=5.0)
+    assert led2.seconds["compile"] == 1.0
+    assert led2.seconds.get("compute", 0.0) == 0.0
+
+
+def test_ledger_unknown_category_books_as_other():
+    led = GoodputLedger()
+    led.book("???", 1.0)
+    led.book("compute", -1.0)  # non-positive: ignored
+    assert led.seconds == {"other": 1.0}
+
+
+# ---------------------------------------------------------------------------
+# compile watch (jax.monitoring)
+# ---------------------------------------------------------------------------
+
+
+def test_compile_watch_counts_real_compiles():
+    import jax
+    import jax.numpy as jnp
+
+    watch = CompileWatch().install()
+    try:
+        assert watch.supported  # this JAX publishes the compile events
+        assert watch.drain() == (0, 0.0)
+        f = jax.jit(lambda x: x * 2 + 1)
+        f(jnp.ones(3))
+        n, secs = watch.drain()
+        assert n >= 1 and secs > 0
+        f(jnp.ones(3))  # cached: no new compile
+        assert watch.drain()[0] == 0
+        f(jnp.ones(5))  # new shape: recompile
+        assert watch.drain()[0] >= 1
+        assert watch.total_count >= 2
+    finally:
+        watch.uninstall()
+
+
+def test_telemetry_flags_unexpected_step_recompile(tmp_path):
+    """A compile observed in a 'step' phase after the first flags the
+    recompile tripwire (shape/dtype drift symptom)."""
+    import jax
+    import jax.numpy as jnp
+
+    tel = Telemetry(sinks=[JsonlSink(str(tmp_path / "t.jsonl"))])
+    try:
+        f = jax.jit(lambda x: x + 1)
+        with tel.phases.phase("step", 1):
+            f(jnp.ones(3))
+        assert tel.registry.counter("compile/unexpected_recompiles").value \
+            == 0
+        with tel.phases.phase("step", 2):
+            f(jnp.ones(7))  # shape drift -> re-jit
+    finally:
+        tel.close()
+    kinds = [json.loads(ln)["kind"] for ln in open(tmp_path / "t.jsonl")]
+    assert "recompile" in kinds
+    assert tel.registry.counter("compile/unexpected_recompiles").value >= 1
+    assert tel.ledger.seconds["compile"] > 0
+
+
+# ---------------------------------------------------------------------------
+# bus + facade
+# ---------------------------------------------------------------------------
+
+
+def test_bus_is_inert_without_install_and_routes_with():
+    bus.emit("retry", category="retry_backoff", secs=1.0)  # no-op, no crash
+    tel = Telemetry(sinks=[])
+    bus.install(tel)
+    try:
+        bus.emit("retry", category="retry_backoff", secs=1.5, attempt=1)
+        assert tel.ledger.seconds["retry_backoff"] == 1.5
+        assert tel.registry.counter("events/retry").value == 1
+    finally:
+        tel.close()
+    assert bus.active() is None  # close uninstalls
+
+
+def test_retry_call_books_backoff_into_ledger():
+    from picotron_tpu.resilience.retry import RetryPolicy, retry_call
+
+    tel = Telemetry(sinks=[])
+    bus.install(tel)
+    try:
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("blip")
+            return "ok"
+
+        policy = RetryPolicy(attempts=3, base_delay=0.5, max_delay=1.0,
+                             jitter=0.0)
+        assert retry_call(flaky, policy=policy, sleep=lambda s: None) == "ok"
+        # two retries: delays 0.5 + 1.0 booked as badput
+        assert tel.ledger.seconds["retry_backoff"] == pytest.approx(1.5)
+        assert tel.registry.counter("events/retry").value == 2
+    finally:
+        tel.close()
+
+
+def test_facade_emit_and_run_summary(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    tel = Telemetry(sinks=[JsonlSink(p)])
+    with tel.phases.phase("data", 1):
+        pass
+    tel.emit("chaos", chaos_kind="sigterm", step=3)
+    tel.record_step(1, "[step 000001] ...", loss=2.0, tokens_per_sec=10.0)
+    tel.record_eval(1, 3.5, "[eval  000001] ...")
+    tel.close()
+    tel.close()  # idempotent
+    rows = [json.loads(ln) for ln in open(p)]
+    kinds = [r["kind"] for r in rows]
+    assert kinds == ["run_start", "phase", "chaos", "step", "eval",
+                     "run_summary"]
+    assert rows[-1]["goodput"]["accounted_seconds"] >= 0
+    assert rows[-1]["metrics"]["counters"]["events/chaos"] == 1
+    assert kinds.count("run_summary") == 1
+
+
+def test_sick_sink_cannot_kill_a_step():
+    class Boom(StdoutSink):
+        def emit(self, event):
+            raise RuntimeError("sink died")
+
+    tel = Telemetry(sinks=[Boom()])
+    try:
+        tel.record_step(1, "line", loss=1.0)  # must not raise
+        tel.emit("retry")
+    finally:
+        tel.close()
+
+
+# ---------------------------------------------------------------------------
+# the frozen stdout contract
+# ---------------------------------------------------------------------------
+
+
+def test_training_log_line_byte_format_is_frozen():
+    """The stdout line is a de-facto API (extract_metrics regex + external
+    scrapers): this pins the exact bytes, not just regex-parseability. A
+    change here is a breaking change to downstream tooling — don't."""
+    from picotron_tpu.utils import training_log_line
+
+    line = training_log_line(7, 2.3456, 13500.0, 1687.5, 0.4321,
+                             1230000, 11.5, extras={"grad_norm": 1.25})
+    assert line == ("[step 000007] loss: 2.3456 | tokens/s: 13.5K | "
+                    "tokens/s/chip: 1.69K | MFU: 43.21% | tokens: 1.23M | "
+                    "mem: 11.5GB | grad_norm: 1.2500")
+
+
+def test_training_log_line_matches_extract_metrics_regex():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    from extract_metrics import LINE_RE, parse_human
+
+    from picotron_tpu.utils import training_log_line
+
+    line = training_log_line(12, 5.4321, 98765.0, 12345.6, 0.1234, 999000,
+                             3.2)
+    m = LINE_RE.search(line)
+    assert m is not None
+    assert int(m.group("step")) == 12
+    assert float(m.group("loss")) == 5.4321
+    assert parse_human(m.group("tps")) == pytest.approx(98765.0, rel=0.01)
+    assert float(m.group("mfu")) == 12.34
+
+
+# ---------------------------------------------------------------------------
+# report tool + extract_metrics integration
+# ---------------------------------------------------------------------------
+
+
+def _write_events(path, events):
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+
+def test_report_reproduces_steps_goodput_and_cross_restart_replay(tmp_path):
+    """Two appended process lifetimes: run 1 trains 1-3 and dies; run 2
+    resumes from the step-2 checkpoint and re-trains 3 before new ground.
+    The report must count distinct steps once, book the re-trained step 3
+    as replay, and sum categories exactly."""
+    rep = load_report()
+    ts = [100.0 + i for i in range(20)]
+    events = [
+        {"ts": ts[0], "kind": "phase", "phase": "step", "step": 1,
+         "category": "compute", "secs": 4.0},
+        {"ts": ts[1], "kind": "compile", "phase": "step", "step": 1,
+         "category": "compile", "secs": 6.0},
+        {"ts": ts[2], "kind": "phase", "phase": "step", "step": 2,
+         "category": "compute", "secs": 4.0},
+        {"ts": ts[3], "kind": "phase", "phase": "save", "step": 2,
+         "category": "ckpt_io", "secs": 1.0},
+        {"ts": ts[4], "kind": "phase", "phase": "step", "step": 3,
+         "category": "compute", "secs": 4.0},
+        # --- process 2 (appended after a kill + auto_resume) ---
+        {"ts": ts[5], "kind": "phase", "phase": "restore", "step": None,
+         "category": "restore", "secs": 2.0},
+        {"ts": ts[6], "kind": "phase", "phase": "step", "step": 3,
+         "category": "compute", "secs": 4.0},   # re-trained -> replay
+        {"ts": ts[7], "kind": "phase", "phase": "step", "step": 4,
+         "category": "compute", "secs": 4.0},
+        {"ts": ts[8], "kind": "step", "step": 4, "loss": 2.5,
+         "tokens_per_sec": 1000.0, "trained_tokens": 4096},
+        {"ts": ts[9], "kind": "retry", "category": "retry_backoff",
+         "secs": 1.0, "target": "checkpoint save"},
+    ]
+    p = tmp_path / "telemetry.jsonl"
+    _write_events(p, events)
+    s = rep.summarize(rep.load_events(str(p)))
+    assert s["steps"] == {"count": 4, "max": 4, "replayed": 1}
+    cats = s["categories"]
+    assert cats["replay"] == 4.0
+    assert cats["compute"] == 16.0
+    assert cats["compile"] == 6.0
+    assert cats["restore"] == 2.0
+    assert cats["retry_backoff"] == 1.0
+    assert s["goodput_pct"] == pytest.approx(
+        100.0 * 16.0 / (16 + 4 + 6 + 1 + 2 + 1), abs=0.01)
+    assert s["training"]["final_loss"] == 2.5
+    # directory form resolves to the contained telemetry.jsonl
+    s2 = rep.summarize(rep.load_events(rep.resolve_path(str(tmp_path))))
+    assert s2["steps"]["count"] == 4
+
+
+def test_report_render_text_and_markdown(tmp_path):
+    rep = load_report()
+    p = tmp_path / "telemetry.jsonl"
+    _write_events(p, [
+        {"ts": 1.0, "kind": "phase", "phase": "step", "step": 1,
+         "category": "compute", "secs": 3.0},
+        {"ts": 2.0, "kind": "phase", "phase": "save", "step": 1,
+         "category": "ckpt_io", "secs": 1.0},
+        {"ts": 3.0, "kind": "chaos", "chaos_kind": "sigterm", "step": 1},
+    ])
+    s = rep.summarize(rep.load_events(str(p)))
+    text = rep.render(s)
+    assert "goodput 75.00%" in text and "ckpt_io" in text
+    md = rep.render(s, markdown=True)
+    assert "| category | seconds | share |" in md
+    assert "| compute | 3.000 | 75.0% |" in md
+    assert "chaos=1" in md
+
+
+def test_report_tolerates_torn_tail_line(tmp_path):
+    rep = load_report()
+    p = tmp_path / "telemetry.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"ts": 1.0, "kind": "phase", "phase": "step",
+                            "step": 1, "category": "compute",
+                            "secs": 1.0}) + "\n")
+        f.write('{"ts": 2.0, "kind": "phase", "ph')  # killed mid-write
+    s = rep.summarize(rep.load_events(str(p)))
+    assert s["steps"]["count"] == 1
+
+
+def test_extract_metrics_prefers_telemetry_jsonl(tmp_path):
+    """The harvester satellite: a run dir carrying telemetry.jsonl is read
+    structurally (full precision + goodput); the console log — present
+    with DIFFERENT numbers — must not be consulted."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import extract_metrics as em
+
+    run = tmp_path / "dp2_tp2_pp1_cp1"
+    run.mkdir()
+    (run / "train.log").write_text(
+        "[step 000005] loss: 9.9999 | tokens/s: 1.0K | tokens/s/chip: 500 "
+        "| MFU: 1.00% | tokens: 10K | mem: 1.0GB\n")
+    events = []
+    for s in range(1, 7):
+        events.append({"ts": float(s), "kind": "phase", "phase": "step",
+                       "step": s, "category": "compute", "secs": 3.0})
+        events.append({"ts": float(s), "kind": "step", "step": s,
+                       "loss": 6.0 - 0.5 * s, "tokens_per_sec": 2000.0,
+                       "tokens_per_sec_per_chip": 500.0, "mfu": 0.45,
+                       "trained_tokens": s * 512, "memory_gb": 1.0,
+                       "grad_norm": 1.5})
+    events.append({"ts": 7.0, "kind": "phase", "phase": "save", "step": 6,
+                   "category": "ckpt_io", "secs": 2.0})
+    events.append({"ts": 7.5, "kind": "eval", "step": 6, "val_loss": 4.25})
+    _write_events(run / "telemetry.jsonl", events)
+
+    stats = em.process_run(str(run), skip_steps=3)
+    assert stats["steps"] == 3                      # steps 4..6 from jsonl
+    assert stats["final_loss"] == pytest.approx(3.0)  # not the log's 9.9999
+    assert stats["mean_mfu_pct"] == pytest.approx(45.0)
+    assert stats["mean_grad_norm"] == pytest.approx(1.5)
+    assert stats["final_val_loss"] == 4.25
+    assert stats["goodput_pct"] == pytest.approx(100.0 * 18 / 20, abs=0.01)
+
+    rows = em.aggregate(str(tmp_path), skip_steps=3)
+    assert rows[0]["dp"] == 2 and rows[0]["goodput_pct"] == stats["goodput_pct"]
+
+
+def test_extract_metrics_telemetry_replay_keeps_last_record(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import extract_metrics as em
+
+    run = tmp_path / "run"
+    run.mkdir()
+    _write_events(run / "telemetry.jsonl", [
+        {"kind": "step", "step": 5, "loss": 99.0, "tokens_per_sec": 1.0,
+         "tokens_per_sec_per_chip": 1.0, "mfu": 0.0},
+        {"kind": "step", "step": 5, "loss": 2.0, "tokens_per_sec": 1.0,
+         "tokens_per_sec_per_chip": 1.0, "mfu": 0.0},  # post-rollback re-run
+    ])
+    stats = em.process_run(str(run), skip_steps=3)
+    assert stats["steps"] == 1 and stats["final_loss"] == 2.0
+
+
+def test_extract_metrics_falls_back_to_log_when_jsonl_empty(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import extract_metrics as em
+
+    run = tmp_path / "run"
+    run.mkdir()
+    (run / "telemetry.jsonl").write_text("")
+    (run / "train.log").write_text(
+        "[step 000005] loss: 4.5000 | tokens/s: 1.0K | tokens/s/chip: 500 "
+        "| MFU: 10.00% | tokens: 10K | mem: 1.0GB\n")
+    stats = em.process_run(str(run), skip_steps=3)
+    assert stats["final_loss"] == 4.5

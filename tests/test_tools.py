@@ -323,6 +323,43 @@ def test_trace_summary_tool(tmp_path, capsys):
     assert list(procs.values()) == ["/device:TPU:0"]
 
 
+def test_telemetry_report_cli_markdown_smoke(tmp_path, capsys):
+    """tools/telemetry_report.py end-to-end over a tiny synthetic stream:
+    the CLI path resolution (run dir -> telemetry.jsonl), the summarize
+    pass, and the --markdown render contract the docs reference."""
+    import json
+
+    events = [
+        {"ts": 1.0, "kind": "phase", "phase": "step", "step": 1,
+         "category": "compute", "secs": 8.0},
+        {"ts": 2.0, "kind": "phase", "phase": "save", "step": 1,
+         "category": "ckpt_io", "secs": 2.0},
+        {"ts": 3.0, "kind": "step", "step": 1, "loss": 3.25,
+         "tokens_per_sec": 1500.0},
+        {"ts": 4.0, "kind": "retry", "category": "retry_backoff",
+         "secs": 0.5, "target": "checkpoint save", "attempt": 1},
+    ]
+    with open(tmp_path / "telemetry.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+    tr = load_tool("telemetry_report")
+    assert tr.main([str(tmp_path), "--markdown"]) == 0
+    out = capsys.readouterr().out
+    assert "goodput 76.19%" in out  # 8 / (8 + 2 + 0.5)
+    assert "| category | seconds | share |" in out
+    assert "| compute | 8.000 | 76.2% |" in out
+    assert "retry=1" in out
+    # --json emits one machine-readable object
+    assert tr.main([str(tmp_path / "telemetry.jsonl"), "--json"]) == 0
+    row = json.loads(capsys.readouterr().out)
+    assert row["steps"]["count"] == 1
+    assert row["categories"]["ckpt_io"] == 2.0
+    # an empty stream is an error, not a zero-filled report
+    (tmp_path / "empty.jsonl").write_text("")
+    assert tr.main([str(tmp_path / "empty.jsonl")]) == 1
+
+
 def test_shardcheck_cli_smoke(capsys):
     """tools/shardcheck.py end-to-end on the CPU backend: preset
     resolution, the full analyzer stack, and the JSON output contract

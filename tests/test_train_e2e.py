@@ -56,6 +56,23 @@ def test_trainer_cli_end_to_end(tmp_path, capsys):
     assert losses[-1] <= losses[0]
     assert "training done" in out
 
+    # The run's structured twin: telemetry.jsonl next to the checkpoints
+    # carries per-phase timings, per-step records matching the console
+    # lines, the exact compile split, and a closing run_summary.
+    events = [json.loads(line) for line in
+              open(tmp_path / "ckpt" / "telemetry.jsonl")]
+    steps = [e for e in events if e["kind"] == "step"]
+    assert [e["step"] for e in steps] == [1, 2, 3, 4, 5]
+    assert [round(e["loss"], 4) for e in steps] == \
+        [float(r["loss"]) for r in rows]
+    summary = events[-1]
+    assert summary["kind"] == "run_summary"
+    cats = summary["goodput"]["seconds_by_category"]
+    assert cats["compute"] > 0 and cats["compile"] > 0
+    phase_steps = {e["step"] for e in events
+                   if e["kind"] == "phase" and e["phase"] == "step"}
+    assert phase_steps == {1, 2, 3, 4, 5}
+
 
 def test_trainer_resume_continues_data_and_steps(tmp_path, capsys):
     """Save at step 3, resume, finish at 6: the resumed run must pick up the

@@ -174,8 +174,15 @@ def create_single_config(args) -> str:
             "split": args.split, "eval_split": args.eval_split,
             "tokenizer_name": args.tokenizer,
         },
+        # save_dir pinned INSIDE the run directory: the dataclass default
+        # ("ckpt") is relative, and submit_jobs launches trainers with
+        # cwd=REPO_ROOT — checkpoints and telemetry.jsonl from every run
+        # would otherwise pile into one shared repo-root ckpt/ (and
+        # extract_metrics could never pair a run with its telemetry).
         "checkpoint": {"save_frequency": args.save_frequency,
-                       "auto_resume": args.auto_resume},
+                       "auto_resume": args.auto_resume,
+                       "save_dir": os.path.abspath(os.path.join(
+                           args.out_dir, args.exp_name, "ckpt"))},
         "logging": {"use_wandb": args.use_wandb, "run_name": args.exp_name},
     }
     if getattr(args, "download_model", False):

@@ -2,20 +2,25 @@
 """Log -> CSV benchmark harvester — parity with the reference's
 extract_metrics.py.
 
-Walks an experiment directory, regex-parses each run's training log for the
-per-step metric line emitted by picotron_tpu.utils.training_log_line (the log
-format is a de-facto API, same contract as the reference's train.py print <->
-extract_metrics.py regexes, ref: extract_metrics.py:55-68), skips warmup
-steps, and writes per-run `metrics.csv` plus a sweep-level
-`global_metrics.csv` (ref: extract_metrics.py:91-99,147-195). Parallel-layout
-parameters are decoded from directory names like `dp8_tp2_pp1_cp1`
-(ref: extract_metrics.py:8-23).
+Walks an experiment directory and harvests each run's per-step metrics:
+runs that carry a structured `telemetry.jsonl` (picotron_tpu/telemetry;
+written next to the checkpoints) are read from it directly — no parsing
+ambiguity, full float precision, plus the goodput % only the event stream
+knows — while runs with only a console log fall back to regex-parsing the
+per-step line emitted by picotron_tpu.utils.training_log_line (the log
+format is a de-facto API, same contract as the reference's train.py print
+<-> extract_metrics.py regexes, ref: extract_metrics.py:55-68). Either
+way: skip warmup steps, write per-run `metrics.csv` plus a sweep-level
+`global_metrics.csv` (ref: extract_metrics.py:91-99,147-195).
+Parallel-layout parameters are decoded from directory names like
+`dp8_tp2_pp1_cp1` (ref: extract_metrics.py:8-23).
 """
 
 from __future__ import annotations
 
 import argparse
 import csv
+import json
 import os
 import re
 from statistics import mean
@@ -85,6 +90,70 @@ def process_file(path: str, skip_steps: int = 3) -> dict | None:
         return None
     # A diverged run must be visible in the sweep, not silently dropped —
     # final_loss will read nan/inf.
+    return _aggregate_rows(rows, val_losses)
+
+
+_STABLE_STEP_FIELDS = {"ts", "kind", "step", "loss", "tokens_per_sec",
+                       "tokens_per_sec_per_chip", "mfu", "trained_tokens",
+                       "memory_gb", "line"}
+
+
+def process_telemetry(path: str, skip_steps: int = 3) -> dict | None:
+    """The structured twin of process_file: per-step rows from a
+    telemetry.jsonl's "step" records (same schema as the regex rows, so
+    the aggregation below is shared) + the goodput % from the stream's
+    (category, secs) accounting. Replayed step numbers (rollback /
+    restart) keep only their LAST record — the one whose update survived
+    into the final weights."""
+    rows_by_step: dict[int, dict] = {}
+    val_losses: list[float] = []
+    categories: dict[str, float] = {}
+    with open(path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                ev = json.loads(raw)
+            except json.JSONDecodeError:
+                continue  # torn tail line of a killed run
+            kind = ev.get("kind")
+            secs = ev.get("secs")
+            if ev.get("category") is not None \
+                    and isinstance(secs, (int, float)):
+                categories[ev["category"]] = \
+                    categories.get(ev["category"], 0.0) + secs
+            if kind == "step" and "step" in ev:
+                row = {
+                    "step": int(ev["step"]),
+                    "loss": float(ev.get("loss", float("nan"))),
+                    "tokens_per_sec": float(ev.get("tokens_per_sec", 0.0)),
+                    "tokens_per_sec_per_chip": float(
+                        ev.get("tokens_per_sec_per_chip", 0.0)),
+                    "mfu_pct": 100.0 * float(ev.get("mfu", 0.0)),
+                }
+                for k, v in ev.items():
+                    if k not in _STABLE_STEP_FIELDS \
+                            and isinstance(v, (int, float)):
+                        row["extra_" + k] = float(v)
+                rows_by_step[row["step"]] = row
+            elif kind == "eval" and "val_loss" in ev:
+                val_losses.append(float(ev["val_loss"]))
+    rows = [r for _, r in sorted(rows_by_step.items())
+            if r["step"] > skip_steps]
+    if not rows:
+        return None
+    out = _aggregate_rows(rows, val_losses)
+    accounted = sum(categories.values())
+    if accounted > 0:
+        out["goodput_pct"] = round(
+            100.0 * categories.get("compute", 0.0) / accounted, 2)
+    return out
+
+
+def _aggregate_rows(rows: list[dict], val_losses: list[float]) -> dict:
+    """Shared row aggregation (regex and telemetry paths must stay
+    column-compatible — global_metrics.csv mixes runs of both kinds)."""
     out = {
         "steps": len(rows),
         "final_loss": rows[-1]["loss"],
@@ -111,16 +180,28 @@ def find_log(run_dir: str) -> str | None:
     return os.path.join(run_dir, logs[0]) if logs else None
 
 
+def process_run(run_dir: str, skip_steps: int = 3) -> dict | None:
+    """telemetry.jsonl when the run has one (checkpoint dir or run root —
+    it sits next to the checkpoints), regex over the console log
+    otherwise."""
+    for sub in ("", "ckpt"):
+        tpath = os.path.join(run_dir, sub, "telemetry.jsonl")
+        if os.path.exists(tpath):
+            stats = process_telemetry(tpath, skip_steps)
+            if stats is not None:
+                return stats
+            break  # present but empty/torn: the log is the fallback
+    log = find_log(run_dir)
+    return process_file(log, skip_steps) if log else None
+
+
 def aggregate(exp_dir: str, skip_steps: int = 3) -> list[dict]:
     results = []
     for name in sorted(os.listdir(exp_dir)):
         run_dir = os.path.join(exp_dir, name)
         if not os.path.isdir(run_dir):
             continue
-        log = find_log(run_dir)
-        if log is None:
-            continue
-        stats = process_file(log, skip_steps)
+        stats = process_run(run_dir, skip_steps)
         if stats is None:
             continue
         row = {"run": name, **decode_run_name(name), **stats}

@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""Post-hoc run triage from a telemetry JSONL stream.
+
+Summarizes a `telemetry.jsonl` (picotron_tpu/telemetry; written next to
+the checkpoints by the trainer) into the questions a run post-mortem
+actually asks: how many distinct steps trained, where did the wall-clock
+go (phase breakdown with p50/p95), what fraction was goodput, what did
+the badput consist of (compile / checkpoint I/O / restore + replayed
+steps / preemption drain / retry backoff / data stall), and which events
+(chaos, guard trips, rollbacks, preemptions, retries, recompiles) fired.
+
+The stream is append-mode across supervised restarts, so one file covers
+a whole preempt/kill/resume saga; steps whose compute phase appears more
+than once (an in-process rollback already reclassified in the ledger, a
+cross-restart replay only visible here) are booked as `replay` badput.
+
+Usage:
+
+  python tools/telemetry_report.py RUN_DIR_OR_JSONL            # text
+  python tools/telemetry_report.py run/ --markdown             # PERF.md-style
+  python tools/telemetry_report.py run/telemetry.jsonl --json  # machine
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from picotron_tpu.telemetry.goodput import (  # noqa: E402
+    GOODPUT_CATEGORIES,
+)
+
+
+def resolve_path(path: str) -> str:
+    """Accept the JSONL itself or a run directory containing one."""
+    if os.path.isdir(path):
+        cand = os.path.join(path, "telemetry.jsonl")
+        if not os.path.exists(cand):
+            raise FileNotFoundError(f"no telemetry.jsonl under {path}")
+        return cand
+    return path
+
+
+def load_events(path: str) -> list[dict]:
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn final line of a killed run is expected
+            if isinstance(ev, dict):
+                events.append(ev)
+    return events
+
+
+def _pctile(xs: list[float], q: float) -> float:
+    """Nearest-rank percentile (same definition as registry.Histogram)."""
+    xs = sorted(xs)
+    rank = max(1, -(-int(q * len(xs)) // 100)) if q > 0 else 1
+    return xs[min(rank, len(xs)) - 1]
+
+
+def summarize(events: list[dict]) -> dict:
+    """Aggregate a stream into {steps, phases, categories, goodput_pct,
+    events, training, wall}. Summing the (category, secs) pairs off the
+    events reproduces the in-process ledger by construction (the phase
+    events carry their resolved category; compile time rides separate
+    category="compile" events) — plus the cross-restart replay
+    reclassification only the whole stream can see."""
+    categories: dict[str, float] = {}
+    phases: dict[str, list[float]] = {}
+    counts: dict[str, int] = {}
+    steps_seen: set[int] = set()
+    replayed = 0
+    step_rows: list[dict] = []
+    eval_rows: list[dict] = []
+    ts = [e["ts"] for e in events if isinstance(e.get("ts"), (int, float))]
+
+    for e in events:
+        kind = e.get("kind")
+        counts[kind] = counts.get(kind, 0) + 1
+        cat, secs = e.get("category"), e.get("secs")
+        if kind == "phase":
+            phases.setdefault(e.get("phase", "?"), []).append(secs or 0.0)
+            step = e.get("step")
+            if e.get("phase") == "step" and step is not None:
+                if cat in ("compute", "replay") and step in steps_seen:
+                    # a step number training twice = lost ground being
+                    # re-bought, whichever process it happened in
+                    cat = "replay"
+                    replayed += 1
+                steps_seen.add(step)
+        if cat is not None and isinstance(secs, (int, float)):
+            categories[cat] = categories.get(cat, 0.0) + secs
+        elif kind == "step":
+            step_rows.append(e)
+        elif kind == "eval":
+            eval_rows.append(e)
+        elif kind == "bench_step" and isinstance(secs, (int, float)):
+            # bench.py --telemetry streams: per-step samples, no phases
+            phases.setdefault("bench_step", []).append(secs)
+
+    accounted = sum(categories.values())
+    goodput = sum(categories.get(c, 0.0) for c in GOODPUT_CATEGORIES)
+    wall = (max(ts) - min(ts)) if len(ts) >= 2 else 0.0
+    out = {
+        "steps": {
+            "count": len(steps_seen),
+            "max": max(steps_seen) if steps_seen else 0,
+            "replayed": replayed,
+        },
+        "phases": {
+            name: {
+                "count": len(xs),
+                "total_s": round(sum(xs), 4),
+                "p50_ms": round(_pctile(xs, 50) * 1e3, 2),
+                "p95_ms": round(_pctile(xs, 95) * 1e3, 2),
+            }
+            for name, xs in sorted(phases.items())
+        },
+        "categories": {k: round(v, 4)
+                       for k, v in sorted(categories.items())},
+        "goodput_pct": (round(100.0 * goodput / accounted, 2)
+                        if accounted > 0 else None),
+        "badput_s": round(accounted - goodput, 4),
+        "accounted_s": round(accounted, 4),
+        "wall_s": round(wall, 4),
+        # Time the stream never saw end-to-end: pre-loop setup, the jit
+        # warm-up outside phases, and phases killed mid-flight (crash,
+        # watchdog os._exit).
+        "unaccounted_s": round(max(wall - accounted, 0.0), 4),
+        "events": dict(sorted(counts.items())),
+    }
+    if step_rows:
+        losses = [r["loss"] for r in step_rows if "loss" in r]
+        tps = [r["tokens_per_sec"] for r in step_rows
+               if "tokens_per_sec" in r]
+        out["training"] = {
+            "records": len(step_rows),
+            "final_step": step_rows[-1].get("step"),
+            "final_loss": losses[-1] if losses else None,
+            "mean_tokens_per_sec": (round(sum(tps) / len(tps), 1)
+                                    if tps else None),
+            "final_trained_tokens": step_rows[-1].get("trained_tokens"),
+        }
+    if eval_rows:
+        out["training"] = out.get("training", {})
+        out["training"]["final_val_loss"] = eval_rows[-1].get("val_loss")
+    return out
+
+
+def render(s: dict, markdown: bool = False) -> str:
+    lines = []
+    gp = s["goodput_pct"]
+    hdr = (f"goodput {gp:.2f}%" if gp is not None else "goodput n/a")
+    lines.append(
+        f"{'## Telemetry report' if markdown else 'telemetry report'} — "
+        f"{hdr} | steps {s['steps']['count']} "
+        f"(max {s['steps']['max']}, replayed {s['steps']['replayed']}) | "
+        f"wall {s['wall_s']:.1f}s "
+        f"(accounted {s['accounted_s']:.1f}s, "
+        f"unaccounted {s['unaccounted_s']:.1f}s)")
+    lines.append("")
+    if markdown:
+        lines += ["| category | seconds | share |", "|---|---|---|"]
+    else:
+        lines.append("time by category:")
+    total = s["accounted_s"] or 1.0
+    for cat, secs in sorted(s["categories"].items(),
+                            key=lambda kv: -kv[1]):
+        share = 100.0 * secs / total
+        if markdown:
+            lines.append(f"| {cat} | {secs:.3f} | {share:.1f}% |")
+        else:
+            lines.append(f"  {cat:14s} {secs:10.3f}s  {share:5.1f}%")
+    lines.append("")
+    if markdown:
+        lines += ["| phase | count | total s | p50 ms | p95 ms |",
+                  "|---|---|---|---|---|"]
+    else:
+        lines.append("phase breakdown:")
+    for name, p in s["phases"].items():
+        if markdown:
+            lines.append(f"| {name} | {p['count']} | {p['total_s']:.3f} | "
+                         f"{p['p50_ms']:.2f} | {p['p95_ms']:.2f} |")
+        else:
+            lines.append(f"  {name:14s} x{p['count']:<6d} "
+                         f"{p['total_s']:10.3f}s  p50 {p['p50_ms']:.2f}ms  "
+                         f"p95 {p['p95_ms']:.2f}ms")
+    lines.append("")
+    ev = ", ".join(f"{k}={v}" for k, v in s["events"].items())
+    lines.append(f"events: {ev}" if not markdown else f"**events:** {ev}")
+    tr = s.get("training")
+    if tr:
+        msg = (f"training: {tr['records']} log records, final step "
+               f"{tr['final_step']}, final loss {tr['final_loss']}, "
+               f"mean tokens/s {tr['mean_tokens_per_sec']}")
+        if tr.get("final_val_loss") is not None:
+            msg += f", final val_loss {tr['final_val_loss']}"
+        lines.append(f"**{msg}**" if markdown else msg)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize a picotron-tpu telemetry.jsonl stream")
+    ap.add_argument("path", help="telemetry.jsonl or a run directory "
+                    "containing one")
+    ap.add_argument("--markdown", action="store_true",
+                    help="emit markdown tables (PERF.md format)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object")
+    args = ap.parse_args(argv)
+
+    events = load_events(resolve_path(args.path))
+    if not events:
+        print(f"no events in {args.path}", file=sys.stderr)
+        return 1
+    s = summarize(events)
+    try:
+        print(json.dumps(s) if args.json else render(s, args.markdown))
+    except BrokenPipeError:  # `... | head` is a supported way to read this
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
