@@ -29,15 +29,22 @@ import dataclasses
 import json
 import os
 import re
+import sys
+import threading
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from picotron_tpu.ckpt_integrity import (
+    VerifyResult, atomic_write_text, build_manifest, retention_plan,
+    rmtree, verify_step_dir, write_manifest,
+)
 from picotron_tpu.config import Config, ModelConfig
 from picotron_tpu.resilience import chaos
 from picotron_tpu.resilience.retry import RetryPolicy, retry_call
+from picotron_tpu.telemetry import bus as telemetry_bus
 from picotron_tpu.train_step import TrainState
 
 
@@ -76,11 +83,23 @@ class CheckpointManager:
     checkpoint.py:232-278; the per-(tp,pp)-rank filename scheme collapses to
     one logical global checkpoint).
 
+    Lineage integrity (picotron_tpu/ckpt_integrity): every save ends with a
+    commit manifest — per-file content digests of the committed step dir,
+    written tmp+rename as the last act, hashed AFTER the async array write
+    lands so the step path never waits on it. Restore-side, durability
+    (Orbax finalization) is necessary but no longer sufficient:
+    `latest_valid_step` walks the lineage newest-first and returns the
+    newest step that is durable AND verifies against its manifest, so a
+    bit-flipped shard or torn meta.json on the newest step costs a
+    fallback (emitting a `ckpt_corrupt` event), not the run. Retention GC
+    (`checkpoint.keep_last` / `keep_every`) prunes after each commit,
+    never the last verified step.
+
     Multihost requirement: `save_dir` must be a filesystem shared by every
     host (GCS / NFS — the standard Cloud TPU arrangement, and what Orbax
-    itself needs to assemble the sharded array write). meta.json is written
-    by process 0 and read by all processes on restore, which assumes the
-    same shared view."""
+    itself needs to assemble the sharded array write). meta.json and the
+    manifest are written by process 0 and read by all processes on
+    restore, which assumes the same shared view."""
 
     def __init__(self, cfg: Config, menv=None, directory: Optional[str] = None):
         import orbax.checkpoint as ocp
@@ -89,6 +108,10 @@ class CheckpointManager:
         self.cfg = cfg
         self.menv = menv
         self.directory = os.path.abspath(directory or cfg.checkpoint.save_dir)
+        # Post-write commit work (manifest hash + write, chaos hook, GC)
+        # runs on this thread for async saves; joined by
+        # wait_until_finished so durability still means "manifest too".
+        self._commit_thread: Optional[threading.Thread] = None
         # Async by default (SURVEY §5 names async Orbax the TPU-native
         # upgrade over the reference's blocking .pth writes, ref:
         # checkpoint.py:246-260): save() returns once the device->host
@@ -139,7 +162,8 @@ class CheckpointManager:
                 # the sidecar metadata must be written once, not per-host.
                 # Written immediately (even mid-async-write): durability
                 # is judged by the finalized `state` dir (latest_step),
-                # not by meta.json.
+                # not by meta.json. tmp+rename so a crash mid-write leaves
+                # no torn JSON under the final name to poison restore.
                 meta = {
                     "step": step,
                     "trained_tokens": int(trained_tokens),
@@ -147,18 +171,70 @@ class CheckpointManager:
                 }
                 if dataloader_state is not None:
                     meta["dataloader"] = dataloader_state
-                with open(os.path.join(path, "meta.json"), "w") as f:
-                    json.dump(meta, f, indent=2)
+                atomic_write_text(os.path.join(path, "meta.json"),
+                                  json.dumps(meta, indent=2))
 
         retry_call(_write, policy=self._retry,
                    describe=f"checkpoint save (step {step})")
+        if self.cfg.checkpoint.async_save:
+            # The manifest hashes the step dir's committed bytes, so it
+            # must run after the async array write lands — on its own
+            # thread, off the step path (the whole point of async saves).
+            self._commit_thread = threading.Thread(
+                target=self._commit, args=(step, path),
+                name=f"ckpt-commit-{step}", daemon=False)
+            self._commit_thread.start()
+        else:
+            self._commit(step, path)
         return path
 
+    def _topology(self) -> dict:
+        d = self.cfg.distributed
+        return {"dp": d.dp_size, "pp": d.pp_size, "ep": d.ep_size,
+                "cp": d.cp_size, "tp": d.tp_size,
+                "world_size": d.world_size,
+                "process_count": jax.process_count()}
+
+    def _commit(self, step: int, path: str) -> None:
+        """Last act of a save: wait for the array write to land, then
+        write the commit manifest (process 0; the write itself is
+        tmp+rename-atomic) and run retention GC. A failure here leaves the
+        checkpoint durable-but-legacy (still restorable, never ranked
+        "verified") rather than failing the run — reported via the probe
+        event, not an exception on the commit thread."""
+        try:
+            self._ckptr.wait_until_finished()
+            if jax.process_index() == 0:
+                def _hash_and_write():
+                    manifest = build_manifest(
+                        path, step=step, topology=self._topology())
+                    write_manifest(path, manifest)
+                    return manifest
+
+                manifest = retry_call(
+                    _hash_and_write, policy=self._probe_retry,
+                    describe=f"manifest commit (step {step})")
+                telemetry_bus.emit(
+                    "ckpt_commit", step=step,
+                    files=manifest["file_count"],
+                    bytes=manifest["total_bytes"])
+                # Corruption chaos mutates the *committed* bytes — the
+                # fault the manifest machinery exists to catch.
+                chaos.fire("ckpt_committed", step=step, path=path)
+                self.gc()
+        except Exception as e:  # noqa: BLE001
+            self._probe_failed(path, e, what="manifest commit")
+
     def wait_until_finished(self) -> None:
-        """Block until any in-flight async save is durable on disk. Call
-        before process exit (train.py does) and before restoring a
-        checkpoint this manager may still be writing."""
+        """Block until any in-flight async save is durable on disk AND its
+        commit manifest is written. Call before process exit (train.py
+        does) and before restoring a checkpoint this manager may still be
+        writing."""
         self._ckptr.wait_until_finished()
+        t = self._commit_thread
+        if t is not None and t is not threading.current_thread():
+            t.join()
+            self._commit_thread = None
 
     def _is_durable(self, step_dirname: str) -> bool:
         """True when the step's `state` checkpoint is fully committed.
@@ -200,24 +276,99 @@ class CheckpointManager:
             return self._probe_failed(state_dir, e)
 
     @staticmethod
-    def _probe_failed(state_dir: str, e: Exception) -> bool:
+    def _probe_failed(state_dir: str, e: Exception,
+                      what: str = "durability probe") -> bool:
         import warnings
 
-        warnings.warn(f"checkpoint durability probe failed for "
+        # Routed through the bus as an event (counted by
+        # tools/telemetry_report.py) so flaky-store noise is visible in
+        # the JSONL stream, not just a stderr warning a supervisor log
+        # rotation eats.
+        telemetry_bus.emit("ckpt_probe_failed", what=what,
+                           path=str(state_dir), error=repr(e))
+        warnings.warn(f"checkpoint {what} failed for "
                       f"{state_dir}: {e!r}; treating as not durable")
         return False
 
+    def steps(self) -> list:
+        """All step numbers with a step_<n> dir, sorted (durable or not)."""
+        return sorted(
+            int(m.group(1)) for d in _listdir(self.directory)
+            if (m := re.fullmatch(r"step_(\d+)", d)))
+
+    def durable_steps(self) -> list:
+        """Step numbers whose `state` checkpoint is fully committed."""
+        return [s for s in self.steps()
+                if self._is_durable(f"step_{s:08d}")]
+
     def latest_step(self) -> Optional[int]:
-        """Newest *durable* checkpoint step. An async save that has not
-        committed yet (or a crashed one) is skipped rather than handed to
-        restore (see _is_durable)."""
-        names = _listdir(self.directory)
-        steps = [
-            int(m.group(1))
-            for d in names
-            if (m := re.fullmatch(r"step_(\d+)", d)) and self._is_durable(d)
-        ]
+        """Newest *durable* checkpoint step — finalized, but NOT content-
+        verified (prefer latest_valid_step, which is). An async save that
+        has not committed yet (or a crashed one) is skipped rather than
+        handed to restore (see _is_durable)."""
+        steps = self.durable_steps()
         return max(steps) if steps else None
+
+    def verify_step(self, step: int, deep: bool = True) -> VerifyResult:
+        """Verify step's bytes against its commit manifest (see
+        ckpt_integrity.verify_step_dir for the verdict semantics)."""
+        return verify_step_dir(self._step_dir(step), deep=deep)
+
+    def _report_corrupt(self, step: int, res: VerifyResult) -> None:
+        telemetry_bus.emit("ckpt_corrupt", step=step,
+                           failures=list(res.failures[:8]))
+        print(f"[ckpt] step {step} failed verification "
+              f"({'; '.join(res.failures[:3]) or res.status}); "
+              f"falling back to an older checkpoint",
+              file=sys.stderr, flush=True)
+
+    def latest_valid_step(self) -> Optional[int]:
+        """Newest step that is durable AND verifies against its commit
+        manifest — what restore/auto-resume/rollback trust. Walks the
+        lineage newest-first; every durable-but-corrupt step it skips on
+        the way down emits a `ckpt_corrupt` telemetry event, so a flipped
+        bit costs a logged fallback to the last known-good step instead
+        of the run."""
+        for step in sorted(self.durable_steps(), reverse=True):
+            res = self.verify_step(step)
+            if res.ok:
+                return step
+            self._report_corrupt(step, res)
+        return None
+
+    def valid_steps(self) -> list:
+        """All durable steps that pass verification, sorted — the restore
+        menu ckpt_doctor and explicit-step error messages show."""
+        return [s for s in self.durable_steps() if self.verify_step(s).ok]
+
+    def gc(self, dry_run: bool = False) -> dict:
+        """Retention GC: prune step dirs per checkpoint.keep_last /
+        keep_every; returns {"kept": [...], "deleted": [...]}. Runs after
+        each durable commit (process 0 only — every other process sees
+        the shared store mutate, same as it does for saves; and only
+        post-commit, when no host can still be mid-restore: restores
+        happen at startup/rollback, strictly before the subsequent save's
+        commit). The last *verified* step is protected unconditionally —
+        keep_last=1 with a corrupt newest step keeps the fallback alive.
+        Only durable steps are candidates: a partially-written dir from a
+        concurrent/crashed save is never touched."""
+        ck = self.cfg.checkpoint
+        if ck.keep_last <= 0:
+            return {"kept": self.steps(), "deleted": []}
+        durable = self.durable_steps()
+        protect = set()
+        last_valid = self.latest_valid_step()
+        if last_valid is not None:
+            protect.add(last_valid)
+        keep, delete = retention_plan(durable, keep_last=ck.keep_last,
+                                      keep_every=ck.keep_every,
+                                      protect=protect)
+        if not dry_run and jax.process_index() == 0:
+            for s in delete:
+                rmtree(self._step_dir(s))
+            if delete:
+                telemetry_bus.emit("ckpt_gc", deleted=delete, kept=keep)
+        return {"kept": keep, "deleted": delete}
 
     def restore(self, state_template: TrainState,
                 step: Optional[int] = None) -> tuple[TrainState, dict]:
@@ -225,13 +376,33 @@ class CheckpointManager:
         topology — resharding is Orbax's job). Returns (state, meta) where
         meta carries at least trained_tokens, plus the dataloader position
         when the checkpoint recorded one.
+
+        With no explicit step this restores the newest durable AND
+        verified checkpoint (latest_valid_step — the lineage-fallback
+        path). An explicit step is validated the same way first, so a
+        non-durable or corrupt request fails with the list of valid steps
+        instead of a raw JSON/Orbax error mid-restore.
         """
-        self._ckptr.wait_until_finished()  # never read our own partial write
+        self.wait_until_finished()  # never read our own partial write
         if step is None:
-            step = self.latest_step()
+            step = self.latest_valid_step()
             if step is None:
                 raise FileNotFoundError(
-                    f"no checkpoints under {self.directory}")
+                    f"no valid checkpoints under {self.directory}")
+        else:
+            if not self._is_durable(f"step_{step:08d}"):
+                raise FileNotFoundError(
+                    f"checkpoint step {step} under {self.directory} is "
+                    f"missing or not durable (save incomplete/crashed); "
+                    f"available valid steps: {self.valid_steps()}")
+            res = self.verify_step(step)
+            if not res.ok:
+                self._report_corrupt(step, res)
+                raise FileNotFoundError(
+                    f"checkpoint step {step} under {self.directory} "
+                    f"failed verification "
+                    f"({'; '.join(res.failures[:3])}); available valid "
+                    f"steps: {self.valid_steps()}")
         path = self._step_dir(step)
 
         def _read_meta():
@@ -318,9 +489,12 @@ def restore_params_only(cfg: Config, ckpt_dir: str,
     menv = MeshEnv.create(dp=1, devices=jax.devices()[:1])
     mgr = CheckpointManager(cfg, menv, directory=ckpt_dir)
     if step is None:
-        step = mgr.latest_step()
+        # Same trust rule as the training restore path: newest durable
+        # AND manifest-verified — export/decode must not read a flipped
+        # bit any more than resume may.
+        step = mgr.latest_valid_step()
         if step is None:
-            raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+            raise FileNotFoundError(f"no valid checkpoints under {ckpt_dir}")
     from picotron_tpu.parallel.api import abstract_master
 
     nl, pp = cfg.model.num_hidden_layers, cfg.distributed.pp_size

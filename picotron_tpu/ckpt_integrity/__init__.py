@@ -1,0 +1,53 @@
+"""Verified checkpoint lineage: integrity manifests, retention, preflight.
+
+`CheckpointManager` (checkpoint.py) historically equated *finalized* with
+*valid*: once Orbax's commit marker existed, restore trusted the bytes
+unconditionally. A bit-flipped shard, a truncated array file, or a torn
+`meta.json` on the newest step killed the run — or worse, resumed it
+silently wrong. This package closes that gap:
+
+- **manifest** — a commit manifest written as the LAST act of every save
+  (atomic tmp+rename): per-payload-file content digests and byte sizes of
+  everything under the step directory, plus the source topology. A step is
+  *verified* when every manifest entry matches the bytes on disk;
+  "finalized => trust it" becomes "finalized AND verified => trust it".
+  Verification is pure reads, so it runs at restore time (and in
+  `tools/ckpt_doctor.py`) without touching the step path.
+- **retention** — the pure `retention_plan` policy behind
+  `checkpoint.keep_last` / `keep_every` GC: prune old steps after each
+  durable commit, provably never the newest retained window, a
+  keep_every anchor, or the last verified step.
+- **preflight** — fail-fast save-dir validation at trainer startup
+  (writable? headroom for one checkpoint, estimated from param+optimizer
+  bytes?) so a doomed `save_dir` dies before pod time is committed, not
+  at the first save.
+
+The consumers: checkpoint.CheckpointManager (manifest commit,
+`latest_valid_step`, GC), train.py (preflight, lineage-fallback restore),
+resilience/chaos.py (the `ckpt_corrupt_*` fault kinds mutate committed
+bytes for exactly this machinery to catch), tools/ckpt_doctor.py (the
+offline fsck).
+"""
+
+from picotron_tpu.ckpt_integrity.manifest import (
+    MANIFEST_NAME, VerifyResult, atomic_write_text, build_manifest,
+    file_digest, rmtree, verify_step_dir, write_manifest,
+)
+from picotron_tpu.ckpt_integrity.preflight import (
+    checkpoint_nbytes, preflight_save_dir,
+)
+from picotron_tpu.ckpt_integrity.retention import retention_plan
+
+__all__ = [
+    "MANIFEST_NAME",
+    "VerifyResult",
+    "atomic_write_text",
+    "build_manifest",
+    "checkpoint_nbytes",
+    "file_digest",
+    "preflight_save_dir",
+    "retention_plan",
+    "rmtree",
+    "verify_step_dir",
+    "write_manifest",
+]

@@ -559,6 +559,15 @@ class CheckpointConfig:
     # reference's bootstrap reads safetensors but only as shape templates,
     # ref: checkpoint.py:93-101; we actually load the values).
     init_from_hf: str = ""
+    # Retention GC (picotron_tpu/ckpt_integrity): after each durable
+    # commit, prune step dirs beyond the keep_last newest. 0 disables
+    # (keep everything — the pre-lineage behavior). keep_every
+    # additionally pins steps divisible by it forever (sparse anchors
+    # under an aggressive keep_last). The last *verified* checkpoint is
+    # never deleted regardless of policy — keep_last=1 with a corrupt
+    # newest step keeps the restore fallback alive.
+    keep_last: int = 0
+    keep_every: int = 0
 
 
 @dataclass(frozen=True)
@@ -691,6 +700,12 @@ class Config:
         self.model.validate()
         self.resilience.validate()
         d, m, t = self.distributed, self.model, self.training
+        ck = self.checkpoint
+        if ck.keep_last < 0 or ck.keep_every < 0:
+            raise ValueError(
+                f"checkpoint.keep_last/keep_every must be >= 0 (0 "
+                f"disables), got keep_last={ck.keep_last} "
+                f"keep_every={ck.keep_every}")
         if self.resilience.guard_policy == "skip" and t.optimizer_offload:
             # The in-jit skip selects the pre-update params/opt state,
             # but the offload update streams the host master in place —

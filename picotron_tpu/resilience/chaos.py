@@ -6,20 +6,28 @@ A chaos spec is a comma-separated list of events, each
 
 - ``KIND``: one of ``sigterm`` / ``sigint`` (deliver that signal to this
   process at the start of step STEP — exercises the real preemption
-  handler), ``hang`` (sleep SECS in the step loop at step STEP),
-  ``ckpt_io`` (raise OSError from the next COUNT checkpoint-save attempts
-  at step STEP — exercises the save retry), ``data_io`` (same for the next
-  COUNT batch-assembly attempts at *batch* STEP), ``data_stall`` (sleep
-  SECS while producing batch STEP — exercises the watchdog), and
-  ``nan_grad`` (poison the gradients/loss of COUNT step executions
-  starting at the first execution of step STEP — a budget, so a
-  guard-rollback re-run of the same step number does not re-fire;
+  handler), ``kill`` (SIGKILL at the start of step STEP: a hard crash —
+  no handler, no emergency checkpoint; exercises supervisor restart from
+  whatever is durable), ``hang`` (sleep SECS in the step loop at step
+  STEP), ``ckpt_io`` (raise OSError from the next COUNT checkpoint-save
+  attempts at step STEP — exercises the save retry), ``data_io`` (same
+  for the next COUNT batch-assembly attempts at *batch* STEP),
+  ``data_stall`` (sleep SECS while producing batch STEP — exercises the
+  watchdog), ``nan_grad`` (poison the gradients/loss of COUNT step
+  executions starting at the first execution of step STEP — a budget, so
+  a guard-rollback re-run of the same step number does not re-fire;
   exercises the divergence guard; injected inside the jitted step via
-  ``make_train_step(..., inject_nan=True)``).
+  ``make_train_step(..., inject_nan=True)``), and the corruption kinds
+  ``ckpt_corrupt_bitflip`` / ``ckpt_truncate`` / ``ckpt_torn_meta``
+  (mutate the checkpoint COMMITTED at step STEP on disk — flip a byte in
+  the largest array payload, truncate it to half, or tear meta.json —
+  exercising manifest verification and the lineage fallback in
+  checkpoint.latest_valid_step).
 - ``xCOUNT`` defaults to 1; ``~SECS`` defaults to 0 and is required for the
   sleep kinds.
 
-Examples: ``sigterm@3``, ``ckpt_io@2x2,nan_grad@4``, ``data_stall@3~10``.
+Examples: ``sigterm@3``, ``ckpt_io@2x2,nan_grad@4``, ``data_stall@3~10``,
+``ckpt_corrupt_bitflip@4,kill@5``.
 
 The spec comes from ``resilience.chaos`` in the config; the
 ``PICOTRON_CHAOS`` environment variable, when set (even to the empty
@@ -43,17 +51,22 @@ import sys
 import time
 from dataclasses import dataclass, field
 
-KINDS = ("sigterm", "sigint", "hang", "ckpt_io", "data_io", "data_stall",
-         "nan_grad")
+KINDS = ("sigterm", "sigint", "kill", "hang", "ckpt_io", "data_io",
+         "data_stall", "nan_grad", "ckpt_corrupt_bitflip", "ckpt_truncate",
+         "ckpt_torn_meta")
 
 # Which event kinds an injection point can trigger. "nan_grad" has no fire
 # point: the driver reads nan_grad_steps() and routes those steps through
 # the poisoned jitted step instead (a host-side hook cannot reach inside
-# the compiled program).
+# the compiled program). "ckpt_committed" fires from CheckpointManager's
+# commit (manifest written, process 0) with the step dir as context — the
+# corruption kinds mutate a checkpoint the store considers good.
 _POINT_KINDS = {
-    "step_begin": ("sigterm", "sigint", "hang"),
+    "step_begin": ("sigterm", "sigint", "kill", "hang"),
     "ckpt_save": ("ckpt_io",),
     "data_produce": ("data_io", "data_stall"),
+    "ckpt_committed": ("ckpt_corrupt_bitflip", "ckpt_truncate",
+                       "ckpt_torn_meta"),
 }
 
 _EVENT_RE = re.compile(
@@ -150,10 +163,12 @@ class ChaosController:
                 return True
         return False
 
-    def fire(self, point: str, step: int) -> None:
+    def fire(self, point: str, step: int, **ctx) -> None:
         """Trigger any event bound to `point` whose step matches and whose
-        firing budget is not exhausted. May sleep, raise OSError, or
-        deliver a signal to this process."""
+        firing budget is not exhausted. May sleep, raise OSError, deliver
+        a signal to this process, or corrupt committed bytes on disk
+        (`ctx["path"]` carries the checkpoint step dir for the
+        ckpt_committed point)."""
         for e in self.events:
             if (e.kind not in _POINT_KINDS.get(point, ())
                     or e.step != step or e.fired >= e.count):
@@ -166,12 +181,73 @@ class ChaosController:
                 os.kill(os.getpid(),
                         signal.SIGTERM if e.kind == "sigterm"
                         else signal.SIGINT)
+            elif e.kind == "kill":
+                os.kill(os.getpid(), signal.SIGKILL)
             elif e.kind in ("hang", "data_stall"):
                 time.sleep(e.secs)
+            elif e.kind in _CORRUPTIONS:
+                _CORRUPTIONS[e.kind](ctx["path"])
             else:  # ckpt_io / data_io
                 raise OSError(
                     f"chaos-injected {e.kind} failure at {point} "
                     f"step {step} ({e.fired}/{e.count})")
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint corruption — the silent-data-corruption failure class. Each
+# mutates a COMMITTED step dir (manifest already written, store considers
+# it good), so recovery must come from verification + lineage fallback,
+# not the commit protocol. Deterministic targets: the largest payload file
+# is the same on every run of the same config.
+# ---------------------------------------------------------------------------
+
+
+def _largest_payload(step_dir: str) -> str:
+    """Biggest file under the step's `state` dir — with Orbax's ocdbt
+    layout that is an array data blob, the realistic bit-rot victim."""
+    best, best_size = None, -1
+    for root, _dirs, files in os.walk(os.path.join(step_dir, "state")):
+        for f in files:
+            p = os.path.join(root, f)
+            size = os.path.getsize(p)
+            if size > best_size:
+                best, best_size = p, size
+    if best is None:
+        raise FileNotFoundError(f"no state payload files under {step_dir}")
+    return best
+
+
+def _corrupt_bitflip(step_dir: str) -> None:
+    p = _largest_payload(step_dir)
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    _log(f"flipped a byte mid-file in {p}")
+
+
+def _corrupt_truncate(step_dir: str) -> None:
+    p = _largest_payload(step_dir)
+    os.truncate(p, os.path.getsize(p) // 2)
+    _log(f"truncated {p} to half")
+
+
+def _corrupt_torn_meta(step_dir: str) -> None:
+    p = os.path.join(step_dir, "meta.json")
+    with open(p, "rb") as f:
+        data = f.read()
+    with open(p, "wb") as f:
+        f.write(data[:max(1, len(data) // 2)])
+    _log(f"tore {p} (half-written JSON)")
+
+
+_CORRUPTIONS = {
+    "ckpt_corrupt_bitflip": _corrupt_bitflip,
+    "ckpt_truncate": _corrupt_truncate,
+    "ckpt_torn_meta": _corrupt_torn_meta,
+}
 
 
 # Module-level controller: library injection points (checkpoint.py,
@@ -195,5 +271,5 @@ def controller() -> ChaosController:
     return _controller
 
 
-def fire(point: str, step: int) -> None:
-    _controller.fire(point, step)
+def fire(point: str, step: int, **ctx) -> None:
+    _controller.fire(point, step, **ctx)

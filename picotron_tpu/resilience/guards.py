@@ -17,9 +17,11 @@ The response is the configured `resilience.guard_policy`:
   resurrect the pre-step state), so the guard only reports. A spike under
   ``skip`` can only be quarantined from the window — its update is already
   applied; use ``rollback`` when spikes must not touch the weights.
-- ``rollback`` — restore the last durable checkpoint and skip past the
-  poison data range (the driver repositions the dataloader to the cursor
-  *after* the bad batch).
+- ``rollback`` — restore the last known-good checkpoint (durable AND
+  manifest-verified: the driver's restore walks past a corrupt newest
+  step down the retention chain, see checkpoint.latest_valid_step) and
+  skip past the poison data range (the driver repositions the dataloader
+  to the cursor *after* the bad batch).
 - ``abort`` — exit `EXIT_DIVERGED` and let a human look.
 
 `max_guard_trips` consecutive trips escalate to abort regardless of

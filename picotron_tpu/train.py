@@ -78,7 +78,11 @@ def build_state(cfg: Config, menv: MeshEnv, tel: Telemetry = None) \
     mgr = None
     if not load_dir and cfg.checkpoint.auto_resume:
         probe = CheckpointManager(cfg, menv)
-        if probe.latest_step() is not None:
+        # Durable AND manifest-verified: a bit-flipped/truncated newest
+        # checkpoint makes the probe (and restore below) walk down the
+        # lineage to the last known-good step — emitting ckpt_corrupt —
+        # instead of resuming silently wrong.
+        if probe.latest_valid_step() is not None:
             load_dir = probe.directory
             mgr = probe  # same dir — reuse, don't build a second manager
             log_print(f"auto_resume: found checkpoints in {load_dir}")
@@ -114,14 +118,16 @@ def _emergency_checkpoint(cfg, menv, ckpt_mgr, state, trained_tokens, dl,
 
 
 def _rollback(ckpt_mgr, state, dl, step, trained_tokens, why):
-    """Divergence-guard rollback: restore the last durable checkpoint and
-    reposition the dataloader to the cursor AFTER the poison batch, so the
-    resumed steps skip the data range that tripped the guard. Returns the
-    restored (state, step, trained_tokens); escalates to EXIT_DIVERGED
-    when there is nothing durable to roll back to."""
-    if ckpt_mgr is None or ckpt_mgr.latest_step() is None:
+    """Divergence-guard rollback: restore the last known-good checkpoint
+    (durable AND manifest-verified — a corrupt newest step is skipped
+    down the lineage, ckpt_integrity) and reposition the dataloader to
+    the cursor AFTER the poison batch, so the resumed steps skip the data
+    range that tripped the guard. Returns the restored (state, step,
+    trained_tokens); escalates to EXIT_DIVERGED when there is nothing
+    valid to roll back to."""
+    if ckpt_mgr is None or ckpt_mgr.latest_valid_step() is None:
         log_print(f"[guard {step:06d}] {why}; rollback requested but no "
-                  f"durable checkpoint exists — aborting "
+                  f"valid checkpoint exists — aborting "
                   f"(exit {EXIT_DIVERGED})")
         raise SystemExit(EXIT_DIVERGED)
     skip_to = dl.state  # position after the poison batch
@@ -184,6 +190,16 @@ def main(argv=None) -> None:
         pre = preflight(cfg, menv)  # raises ShardcheckError with the report
         log_print(f"shardcheck preflight: ok "
                   f"({len(pre.warnings())} warning(s))")
+        if cfg.checkpoint.save_frequency > 0:
+            # Same fail-fast contract for the checkpoint store: an
+            # unwritable save_dir or a disk without headroom for one
+            # checkpoint must die here, not at the first periodic save
+            # hours in (picotron_tpu/ckpt_integrity.preflight).
+            from picotron_tpu.ckpt_integrity import preflight_save_dir
+
+            est = preflight_save_dir(cfg)  # raises RuntimeError w/ story
+            log_print(f"checkpoint preflight: ok ({cfg.checkpoint.save_dir}"
+                      f", ~{est / 1e9:.2f} GB/checkpoint)")
 
     n_chips = menv.world_size
     n_params = num_params(cfg.model)

@@ -121,6 +121,98 @@ def test_latest_step_skips_unfinalized_checkpoints(tmp_path):
     assert mgr.latest_step() == 0
 
 
+def _save_two_steps(tmp_path, cfg, menv):
+    """Two durable, verified real checkpoints (steps 1 and 2)."""
+    state = init_sharded_state(cfg, menv, jax.random.key(0))
+    step = make_train_step(cfg, menv)
+    mgr = CheckpointManager(cfg, menv)
+    batch = batch_for(cfg, menv)
+    state, _ = step(state, batch)
+    mgr.save(state, trained_tokens=100)
+    state, _ = step(state, batch)
+    mgr.save(state, trained_tokens=200)
+    mgr.wait_until_finished()
+    return mgr, state
+
+
+def test_torn_meta_json_falls_back_to_prior_verified(tmp_path):
+    """A crash (or chaos ckpt_torn_meta) tearing the newest step's
+    meta.json must cost a lineage fallback, not a JSONDecodeError at
+    resume: latest_valid_step skips it, restore lands on the prior
+    verified step."""
+    cfg = make_cfg(tmp_path, dp_size=2)
+    menv = MeshEnv.from_config(cfg)
+    mgr, _ = _save_two_steps(tmp_path, cfg, menv)
+    meta_path = tmp_path / "ckpt" / "step_00000002" / "meta.json"
+    meta_path.write_bytes(meta_path.read_bytes()[:40])  # torn mid-write
+    assert mgr.latest_step() == 2          # still durable...
+    assert mgr.latest_valid_step() == 1    # ...but no longer trusted
+    template = init_sharded_state(cfg, menv, jax.random.key(9))
+    restored, meta = mgr.restore(template)
+    assert int(restored.step) == 1 and meta["trained_tokens"] == 100
+
+
+def test_valid_manifest_with_deleted_array_file_falls_back(tmp_path):
+    """Manifest present and well-formed, but an array payload file was
+    deleted (partial store loss): verification flags the missing leaf and
+    the lineage walk falls back."""
+    import os
+
+    cfg = make_cfg(tmp_path, dp_size=2)
+    menv = MeshEnv.from_config(cfg)
+    mgr, _ = _save_two_steps(tmp_path, cfg, menv)
+    state_dir = tmp_path / "ckpt" / "step_00000002" / "state"
+    victim = max(
+        (p for p in state_dir.rglob("*") if p.is_file()),
+        key=lambda p: p.stat().st_size)
+    os.remove(victim)
+    res = mgr.verify_step(2)
+    assert res.status == "corrupt"
+    assert any("missing" in f for f in res.failures)
+    assert mgr.latest_valid_step() == 1
+    template = init_sharded_state(cfg, menv, jax.random.key(9))
+    restored, _ = mgr.restore(template)
+    assert int(restored.step) == 1
+
+
+def test_explicit_step_restore_validates_durability(tmp_path):
+    """restore(step=N) used to skip the durability probe and die on a raw
+    JSON/Orbax error; now it validates first and names the valid steps."""
+    cfg = make_cfg(tmp_path, dp_size=2)
+    menv = MeshEnv.from_config(cfg)
+    mgr, _ = _save_two_steps(tmp_path, cfg, menv)
+    # a torn step dir: meta.json only, no finalized state (crashed save)
+    torn = tmp_path / "ckpt" / "step_00000007"
+    torn.mkdir(parents=True)
+    (torn / "meta.json").write_text("{}")
+    template = init_sharded_state(cfg, menv, jax.random.key(9))
+    with pytest.raises(FileNotFoundError,
+                       match=r"not durable.*available valid steps.*\[1, 2\]"):
+        mgr.restore(template, step=7)
+    with pytest.raises(FileNotFoundError, match="available valid steps"):
+        mgr.restore(template, step=42)  # absent entirely
+
+
+def test_gc_keeps_last_verified_with_keep_last_1(tmp_path):
+    """Retention GC under keep_last=1 with a corrupt newest checkpoint:
+    the last verified step must survive the prune and still restore."""
+    import dataclasses
+    import os
+
+    cfg = make_cfg(tmp_path, dp_size=2)
+    menv = MeshEnv.from_config(cfg)
+    mgr, _ = _save_two_steps(tmp_path, cfg, menv)
+    state_dir = tmp_path / "ckpt" / "step_00000002" / "state"
+    victim = max((p for p in state_dir.rglob("*") if p.is_file()),
+                 key=lambda p: p.stat().st_size)
+    os.truncate(victim, 1)
+    cfg1 = dataclasses.replace(
+        cfg, checkpoint=dataclasses.replace(cfg.checkpoint, keep_last=1))
+    res = CheckpointManager(cfg1, menv).gc()
+    assert res["deleted"] == [] and res["kept"] == [1, 2]
+    assert CheckpointManager(cfg1, menv).latest_valid_step() == 1
+
+
 def test_restore_across_topologies(tmp_path):
     """Save under dp=2,tp=2 / restore under tp=4: Orbax reshards into the
     template's shardings — the reference hard-fails on this

@@ -1,0 +1,452 @@
+"""Checkpoint lineage integrity (picotron_tpu/ckpt_integrity +
+checkpoint.py surgery): commit manifests and verification, corruption
+chaos kinds, lineage fallback in latest_valid_step, retention GC,
+save-dir preflight, probe-failure telemetry routing, and the
+tools/ckpt_doctor.py fsck CLI."""
+
+import json
+import os
+import sys
+
+import jax.numpy as jnp
+import pytest
+
+from picotron_tpu.checkpoint import CheckpointManager
+from picotron_tpu.ckpt_integrity import (
+    MANIFEST_NAME, atomic_write_text, build_manifest, preflight_save_dir,
+    retention_plan, verify_step_dir, write_manifest,
+)
+from picotron_tpu.config import config_from_dict
+from picotron_tpu.resilience import chaos
+from picotron_tpu.telemetry import bus
+from picotron_tpu.train_step import TrainState
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _hygiene():
+    """Chaos and the telemetry bus are process-global; start/end inert."""
+    chaos.install("")
+    bus.install(None)
+    yield
+    chaos.install("")
+    bus.install(None)
+
+
+class _RecordingTelemetry:
+    """Minimal bus target: records every emitted event."""
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, *, category=None, secs=None, **fields):
+        self.events.append({"kind": kind, **fields})
+
+    def kinds(self):
+        return [e["kind"] for e in self.events]
+
+
+def _toy_state(step):
+    return TrainState(params={"w": jnp.arange(512.0) + step},
+                      opt_state={"m": jnp.zeros(512)},
+                      step=jnp.asarray(step, jnp.int32))
+
+
+def _mgr(tmp_path, **ckpt_overrides):
+    ck = {"save_dir": str(tmp_path / "ckpt"), "save_frequency": 1,
+          "async_save": False, **ckpt_overrides}
+    cfg = config_from_dict({
+        "model": {"name": "debug-tiny"},
+        "checkpoint": ck,
+        "resilience": {"retry_base_delay": 0.01, "retry_max_delay": 0.02},
+    })
+    return CheckpointManager(cfg)
+
+
+def _step_dir(mgr, step):
+    return os.path.join(mgr.directory, f"step_{step:08d}")
+
+
+def _largest_state_file(step_dir):
+    best, size = None, -1
+    for root, _dirs, files in os.walk(os.path.join(step_dir, "state")):
+        for f in files:
+            p = os.path.join(root, f)
+            if os.path.getsize(p) > size:
+                best, size = p, os.path.getsize(p)
+    return best
+
+
+# ---------------------------------------------------------------------------
+# manifest build / verify
+# ---------------------------------------------------------------------------
+
+
+def test_save_writes_manifest_and_verifies(tmp_path):
+    mgr = _mgr(tmp_path)
+    mgr.save(_toy_state(3), trained_tokens=30)
+    sd = _step_dir(mgr, 3)
+    man = json.load(open(os.path.join(sd, MANIFEST_NAME)))
+    assert man["step"] == 3 and man["file_count"] == len(man["files"])
+    assert "meta.json" in man["files"]  # the sidecar is covered too
+    assert any(r.startswith("state/") for r in man["files"])
+    assert man["total_bytes"] == sum(f["bytes"] for f in man["files"].values())
+    assert man["topology"]["world_size"] == 1
+    res = mgr.verify_step(3)
+    assert res.status == "verified" and res.ok and not res.failures
+
+
+def test_async_save_commits_manifest_after_barrier(tmp_path):
+    mgr = _mgr(tmp_path, async_save=True)
+    mgr.save(_toy_state(1), trained_tokens=10)
+    mgr.wait_until_finished()  # joins the commit thread too
+    assert os.path.exists(os.path.join(_step_dir(mgr, 1), MANIFEST_NAME))
+    assert mgr.latest_valid_step() == 1
+
+
+def test_bitflip_detected_and_names_the_file(tmp_path):
+    mgr = _mgr(tmp_path)
+    mgr.save(_toy_state(1))
+    sd = _step_dir(mgr, 1)
+    victim = _largest_state_file(sd)
+    with open(victim, "r+b") as f:
+        f.seek(os.path.getsize(victim) // 2)
+        b = f.read(1)
+        f.seek(os.path.getsize(victim) // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    res = mgr.verify_step(1)
+    assert res.status == "corrupt"
+    rel = os.path.relpath(victim, sd).replace(os.sep, "/")
+    assert any(rel in f and "digest" in f for f in res.failures)
+
+
+def test_truncation_detected_even_shallow(tmp_path):
+    """Size checks alone (deep=False) catch truncation/deletion — the
+    cheap triage mode ckpt_doctor --shallow uses."""
+    mgr = _mgr(tmp_path)
+    mgr.save(_toy_state(1))
+    victim = _largest_state_file(_step_dir(mgr, 1))
+    os.truncate(victim, os.path.getsize(victim) // 2)
+    res = mgr.verify_step(1, deep=False)
+    assert res.status == "corrupt"
+    assert any("size" in f for f in res.failures)
+
+
+def test_torn_manifest_is_corrupt(tmp_path):
+    mgr = _mgr(tmp_path)
+    mgr.save(_toy_state(1))
+    mp = os.path.join(_step_dir(mgr, 1), MANIFEST_NAME)
+    data = open(mp, "rb").read()
+    open(mp, "wb").write(data[: len(data) // 2])
+    res = mgr.verify_step(1)
+    assert res.status == "corrupt"
+    assert any(MANIFEST_NAME in f for f in res.failures)
+
+
+def test_legacy_checkpoint_without_manifest_stays_restorable(tmp_path):
+    """Pre-lineage checkpoints (no manifest) must not be orphaned by the
+    upgrade: durable + parseable meta.json => restorable ("legacy"), but
+    never ranked "verified"."""
+    mgr = _mgr(tmp_path)
+    mgr.save(_toy_state(2), trained_tokens=20)
+    os.remove(os.path.join(_step_dir(mgr, 2), MANIFEST_NAME))
+    res = mgr.verify_step(2)
+    assert res.status == "legacy" and res.ok
+    assert mgr.latest_valid_step() == 2
+    restored, meta = mgr.restore(_toy_state(0))
+    assert int(restored.step) == 2 and meta["trained_tokens"] == 20
+
+
+def test_atomic_write_leaves_no_tmp_and_replaces(tmp_path):
+    p = str(tmp_path / "meta.json")
+    atomic_write_text(p, '{"a": 1}')
+    atomic_write_text(p, '{"a": 2}')
+    assert json.load(open(p)) == {"a": 2}
+    assert os.listdir(tmp_path) == ["meta.json"]  # no .tmp.* residue
+
+
+def test_manifest_skips_tmp_staging_files(tmp_path):
+    d = tmp_path / "step"
+    (d / "state").mkdir(parents=True)
+    (d / "state" / "payload").write_bytes(b"x" * 100)
+    (d / "meta.json").write_text("{}")
+    (d / "meta.json.tmp.123").write_text("{")  # in-flight staging junk
+    man = build_manifest(str(d), step=1)
+    assert set(man["files"]) == {"meta.json", "state/payload"}
+    write_manifest(str(d), man)
+    assert verify_step_dir(str(d)).status == "verified"
+
+
+# ---------------------------------------------------------------------------
+# chaos corruption kinds
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_spec_accepts_corruption_and_kill_kinds():
+    evs = chaos.parse_spec("ckpt_corrupt_bitflip@4,ckpt_truncate@6,"
+                           "ckpt_torn_meta@8,kill@5")
+    assert [e.kind for e in evs] == [
+        "ckpt_corrupt_bitflip", "ckpt_truncate", "ckpt_torn_meta", "kill"]
+
+
+@pytest.mark.parametrize("kind,expect_fragment", [
+    ("ckpt_corrupt_bitflip", "digest"),
+    ("ckpt_truncate", "size"),
+    ("ckpt_torn_meta", "meta.json"),
+])
+def test_chaos_corruption_kinds_break_verification(tmp_path, kind,
+                                                   expect_fragment):
+    mgr = _mgr(tmp_path)
+    mgr.save(_toy_state(4), trained_tokens=40)
+    assert mgr.verify_step(4).status == "verified"
+    ctrl = chaos.ChaosController(chaos.parse_spec(f"{kind}@4"))
+    ctrl.fire("ckpt_committed", step=4, path=_step_dir(mgr, 4))
+    ctrl.fire("ckpt_committed", step=4, path=_step_dir(mgr, 4))  # exhausted
+    res = mgr.verify_step(4)
+    assert res.status == "corrupt"
+    assert any(expect_fragment in f for f in res.failures), res.failures
+
+
+def test_chaos_corruption_wrong_point_or_step_noop(tmp_path):
+    mgr = _mgr(tmp_path)
+    mgr.save(_toy_state(4))
+    ctrl = chaos.ChaosController(chaos.parse_spec("ckpt_corrupt_bitflip@4"))
+    ctrl.fire("step_begin", step=4)  # corruption kinds bind ckpt_committed
+    ctrl.fire("ckpt_committed", step=3, path=_step_dir(mgr, 4))
+    assert mgr.verify_step(4).status == "verified"
+
+
+def test_end_to_end_chaos_corruption_during_commit(tmp_path):
+    """The full injection path: a chaos spec installed process-wide
+    corrupts the checkpoint as its commit completes (the ckpt_committed
+    fire inside CheckpointManager._commit), and the next save's lineage
+    walk falls back over it."""
+    mgr = _mgr(tmp_path)
+    chaos.install("ckpt_corrupt_bitflip@2")
+    mgr.save(_toy_state(1), trained_tokens=10)
+    mgr.save(_toy_state(2), trained_tokens=20)  # corrupted at commit
+    assert mgr.verify_step(1).status == "verified"
+    assert mgr.verify_step(2).status == "corrupt"
+    assert mgr.latest_valid_step() == 1
+    restored, meta = mgr.restore(_toy_state(0))
+    assert int(restored.step) == 1 and meta["trained_tokens"] == 10
+
+
+# ---------------------------------------------------------------------------
+# lineage fallback + telemetry events
+# ---------------------------------------------------------------------------
+
+
+def test_latest_valid_step_walks_lineage_and_emits_ckpt_corrupt(tmp_path):
+    tel = _RecordingTelemetry()
+    bus.install(tel)
+    mgr = _mgr(tmp_path)
+    for s in (2, 4):
+        mgr.save(_toy_state(s), trained_tokens=10 * s)
+    victim = _largest_state_file(_step_dir(mgr, 4))
+    os.remove(victim)  # valid manifest, deleted array file
+    assert mgr.latest_valid_step() == 2
+    corrupt = [e for e in tel.events if e["kind"] == "ckpt_corrupt"]
+    assert corrupt and corrupt[0]["step"] == 4
+    assert any("missing" in f for f in corrupt[0]["failures"])
+
+
+def test_probe_failure_routed_through_bus(tmp_path):
+    """_probe_failed is an event stream citizen now, not just a stderr
+    warning: flaky-store noise must be countable in telemetry_report."""
+    tel = _RecordingTelemetry()
+    bus.install(tel)
+    mgr = _mgr(tmp_path)
+    mgr.save(_toy_state(1))
+
+    class BrokenUtils:
+        @staticmethod
+        def is_checkpoint_finalized(path):
+            raise RuntimeError("metadata service melted")
+
+    class FakeOcp:
+        utils = BrokenUtils
+
+    mgr._ocp = FakeOcp
+    with pytest.warns(UserWarning, match="durability probe"):
+        assert mgr.latest_step() is None
+    probe = [e for e in tel.events if e["kind"] == "ckpt_probe_failed"]
+    assert probe and "melted" in probe[0]["error"]
+
+
+# ---------------------------------------------------------------------------
+# retention policy + GC
+# ---------------------------------------------------------------------------
+
+
+def test_retention_plan_policy():
+    steps = list(range(1, 11))
+    keep, delete = retention_plan(steps, keep_last=0)
+    assert (keep, delete) == (steps, [])  # 0 disables GC
+    keep, delete = retention_plan(steps, keep_last=2)
+    assert keep == [9, 10] and delete == list(range(1, 9))
+    keep, delete = retention_plan(steps, keep_last=2, keep_every=5)
+    assert keep == [5, 9, 10]
+    keep, delete = retention_plan(steps, keep_last=1, protect=[3, 99])
+    assert keep == [3, 10]  # protect applies only to existing steps
+    assert 99 not in keep + delete
+    assert sorted(keep + delete) == steps
+
+
+def test_gc_keep_last_2_over_10_saves(tmp_path):
+    """The acceptance criterion: keep_last=2 over a 10-save run leaves
+    exactly the expected step dirs after every prune, and
+    latest_valid_step resolves throughout."""
+    mgr = _mgr(tmp_path, keep_last=2)
+    for s in range(1, 11):
+        mgr.save(_toy_state(s), trained_tokens=s)
+        expect = {f"step_{x:08d}" for x in (s - 1, s) if x >= 1}
+        assert set(os.listdir(mgr.directory)) == expect
+        assert mgr.latest_valid_step() == s
+    restored, _ = mgr.restore(_toy_state(0))
+    assert int(restored.step) == 10
+
+
+def test_gc_keep_every_pins_anchor_steps(tmp_path):
+    mgr = _mgr(tmp_path, keep_last=1, keep_every=4)
+    for s in range(1, 10):
+        mgr.save(_toy_state(s))
+    assert [int(d.split("_")[1]) for d in sorted(os.listdir(mgr.directory))] \
+        == [4, 8, 9]
+
+
+def test_gc_never_deletes_last_verified_even_keep_last_1(tmp_path):
+    """keep_last=1 with a corrupt newest step: the last verified
+    checkpoint is the only restore fallback and must survive GC."""
+    mgr = _mgr(tmp_path, keep_last=0)  # build the lineage without pruning
+    for s in (1, 2, 3):
+        mgr.save(_toy_state(s), trained_tokens=10 * s)
+    os.truncate(_largest_state_file(_step_dir(mgr, 3)), 1)  # corrupt newest
+    aggressive = _mgr(tmp_path, keep_last=1)
+    res = aggressive.gc()
+    # keep_last=1 keeps the newest (3, corrupt); the protection clause
+    # keeps 2 (last verified); 1 is pruned
+    assert res["kept"] == [2, 3] and res["deleted"] == [1]
+    assert sorted(os.listdir(mgr.directory)) == [
+        "step_00000002", "step_00000003"]
+    assert aggressive.latest_valid_step() == 2
+    restored, meta = aggressive.restore(_toy_state(0))
+    assert int(restored.step) == 2 and meta["trained_tokens"] == 20
+
+
+def test_gc_dry_run_deletes_nothing(tmp_path):
+    mgr = _mgr(tmp_path, keep_last=0)  # in-save GC disabled
+    for s in (1, 2, 3):
+        mgr.save(_toy_state(s))
+    mgr3 = _mgr(tmp_path, keep_last=1)
+    plan = mgr3.gc(dry_run=True)
+    assert plan["deleted"] == [1, 2]
+    assert len(os.listdir(mgr.directory)) == 3  # untouched
+
+
+# ---------------------------------------------------------------------------
+# save-dir preflight
+# ---------------------------------------------------------------------------
+
+
+def test_preflight_ok_on_writable_dir(tmp_path):
+    cfg = config_from_dict({
+        "model": {"name": "debug-tiny"},
+        "checkpoint": {"save_dir": str(tmp_path / "ckpt"),
+                       "save_frequency": 2},
+    })
+    est = preflight_save_dir(cfg)
+    assert est > 0
+    assert os.path.isdir(tmp_path / "ckpt")
+    assert not os.listdir(tmp_path / "ckpt")  # probe file cleaned up
+
+
+def test_preflight_rejects_uncreatable_save_dir(tmp_path):
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("i am a file")
+    cfg = config_from_dict({
+        "model": {"name": "debug-tiny"},
+        "checkpoint": {"save_dir": str(blocker / "ckpt"),
+                       "save_frequency": 2},
+    })
+    with pytest.raises(RuntimeError, match="cannot be created"):
+        preflight_save_dir(cfg)
+
+
+def test_preflight_rejects_insufficient_headroom(tmp_path, monkeypatch):
+    import shutil as _shutil
+
+    cfg = config_from_dict({
+        "model": {"name": "debug-tiny"},
+        "checkpoint": {"save_dir": str(tmp_path / "ckpt"),
+                       "save_frequency": 2, "keep_last": 4},
+    })
+    monkeypatch.setattr(_shutil, "disk_usage",
+                        lambda p: type("DU", (), {"free": 10})())
+    with pytest.raises(RuntimeError, match="GB free"):
+        preflight_save_dir(cfg)
+
+
+def test_preflight_skips_url_stores():
+    cfg = config_from_dict({
+        "model": {"name": "debug-tiny"},
+        "checkpoint": {"save_dir": "gs://nonexistent-bucket/ckpt",
+                       "save_frequency": 2},
+    })
+    assert preflight_save_dir(cfg) > 0  # no local probe, estimate only
+
+
+# ---------------------------------------------------------------------------
+# tools/ckpt_doctor.py
+# ---------------------------------------------------------------------------
+
+
+def _load_doctor():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "ckpt_doctor", os.path.join(os.path.dirname(__file__), "..",
+                                    "tools", "ckpt_doctor.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_ckpt_doctor_flags_exactly_the_corrupt_step(tmp_path, capsys):
+    mgr = _mgr(tmp_path)
+    for s in (2, 4, 6):
+        mgr.save(_toy_state(s), trained_tokens=s)
+    victim = _largest_state_file(_step_dir(mgr, 4))
+    with open(victim, "r+b") as f:
+        f.seek(0)
+        b = f.read(1)
+        f.seek(0)
+        f.write(bytes([b[0] ^ 0xFF]))
+    doctor = _load_doctor()
+    rows = doctor.scan(mgr.directory)
+    assert [(r["step"], r["verdict"]) for r in rows] == [
+        (2, "verified"), (4, "corrupt"), (6, "verified")]
+    assert doctor.main([mgr.directory, "--json"]) == 1  # corrupt => exit 1
+    out = json.loads(capsys.readouterr().out)
+    assert [r["verdict"] for r in out["steps"]] == [
+        "verified", "corrupt", "verified"]
+    # markdown render smoke
+    assert doctor.main([mgr.directory, "--markdown"]) == 1
+    md = capsys.readouterr().out
+    assert "| 4 | corrupt |" in md
+
+
+def test_ckpt_doctor_gc_dry_run_then_apply(tmp_path, capsys):
+    mgr = _mgr(tmp_path)
+    for s in range(1, 6):
+        mgr.save(_toy_state(s))
+    doctor = _load_doctor()
+    assert doctor.main([mgr.directory, "--gc", "--keep-last", "2",
+                        "--dry-run"]) == 0
+    assert len(os.listdir(mgr.directory)) == 5  # dry run: untouched
+    assert doctor.main([mgr.directory, "--gc", "--keep-last", "2"]) == 0
+    assert sorted(os.listdir(mgr.directory)) == [
+        "step_00000004", "step_00000005"]
+    assert mgr.latest_valid_step() == 5

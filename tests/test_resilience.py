@@ -540,7 +540,7 @@ def test_chaos_cli_lists_every_scenario(capsys):
     assert cli.main(["--list"]) == 0
     out = capsys.readouterr().out
     for name in ("sigterm", "ckpt_io", "nan_skip", "nan_rollback",
-                 "data_stall"):
+                 "data_stall", "ckpt_corrupt_bitflip"):
         assert name in out
 
 
@@ -560,12 +560,19 @@ _SCENARIO_TELEMETRY = {
     # ledger via the watchdog's own timeout event (category data_wait)
     "data_stall": {"badput": ["restore", "data_wait"],
                    "events": ["chaos", "watchdog_timeout"]},
+    # newest committed checkpoint bit-flipped then SIGKILL: the restart's
+    # verify-on-restore emits ckpt_corrupt, falls back to the prior
+    # verified step, and re-buys the lost ground (replayed steps)
+    "ckpt_corrupt_bitflip": {"badput": ["restore"],
+                             "events": ["chaos", "ckpt_corrupt",
+                                        "ckpt_commit"]},
 }
 
 
 @pytest.mark.slow
 @pytest.mark.parametrize("scenario", [
-    "sigterm", "ckpt_io", "nan_skip", "nan_rollback", "data_stall"])
+    "sigterm", "ckpt_io", "nan_skip", "nan_rollback", "data_stall",
+    "ckpt_corrupt_bitflip"])
 def test_chaos_scenario_recovers_to_baseline(tmp_path, scenario):
     """The acceptance contract, both halves: under each injected failure
     the supervised run (a) ends at the same final step and trained_tokens
@@ -597,5 +604,5 @@ def test_chaos_scenario_recovers_to_baseline(tmp_path, scenario):
     for kind in expect["events"]:
         assert s["events"].get(kind, 0) > 0, \
             f"{scenario}: event {kind!r} absent: {s['events']}"
-    if scenario == "nan_rollback":
+    if scenario in ("nan_rollback", "ckpt_corrupt_bitflip"):
         assert s["steps"]["replayed"] > 0  # re-trained ground is counted

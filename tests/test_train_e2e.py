@@ -134,6 +134,56 @@ def test_trainer_auto_resume_preemption_recovery(tmp_path, capsys):
     assert rows2 == [4, 5]  # resumed at the final step-3 save
 
 
+def test_ckpt_preflight_fails_fast_on_unwritable_save_dir(tmp_path,
+                                                          monkeypatch):
+    """A doomed save_dir (here: a file where the directory should go)
+    must kill the run during startup preflight — before any compile or
+    training — not at the first periodic save."""
+    monkeypatch.delenv("PICOTRON_PREFLIGHT", raising=False)
+    blocker = tmp_path / "blocker"
+    blocker.write_text("i am a file, not a directory")
+    cfg = write_cfg(
+        tmp_path,
+        checkpoint={"save_dir": str(blocker / "ckpt"), "save_frequency": 2})
+    with pytest.raises(RuntimeError, match="checkpoint preflight"):
+        train.main(["--config", cfg])
+
+
+def test_trainer_restart_falls_back_over_corrupt_newest_ckpt(tmp_path,
+                                                             capsys):
+    """In-process twin of the ckpt_corrupt_bitflip chaos scenario: run to
+    completion with periodic saves, bit-flip the newest committed
+    checkpoint, and re-run with a higher budget — auto_resume must verify,
+    emit the fallback, and resume from the prior verified step."""
+    import os
+
+    cfg = write_cfg(
+        tmp_path,
+        training={"total_train_steps": 4},
+        checkpoint={"save_frequency": 2, "auto_resume": True})
+    run_main(cfg, capsys)
+    # corrupt the newest (step-4) checkpoint's largest array payload
+    state_dir = tmp_path / "ckpt" / "step_00000004" / "state"
+    victim = max((p for p in state_dir.rglob("*") if p.is_file()),
+                 key=lambda p: p.stat().st_size)
+    size = victim.stat().st_size
+    with open(victim, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+    cfg2 = write_cfg(
+        tmp_path, name="resub.json",
+        training={"total_train_steps": 6},
+        checkpoint={"save_frequency": 2, "auto_resume": True})
+    out = run_main(cfg2, capsys)
+    rows = [int(m.group("step")) for line in out.splitlines()
+            if (m := LINE_RE.search(line))]
+    assert rows == [3, 4, 5, 6]  # resumed from step 2, NOT the corrupt 4
+    assert "at step 2" in out  # build_state's resume line
+
+
 def test_trainer_eval_loop(tmp_path, capsys):
     """eval_frequency runs a forward-only validation pass on a disjoint
     synthetic stream and logs val_loss lines; the final step always

@@ -17,6 +17,11 @@ Scenarios (the runtime-failure matrix README "Fault tolerance" documents):
   nan_skip      NaN gradients, guard_policy=skip -> batch dropped in-step
   nan_rollback  NaN gradients, guard_policy=rollback -> restore + skip data
   data_stall    stuck data producer -> watchdog exit 77 -> resume
+  ckpt_corrupt_bitflip
+                newest committed checkpoint bit-flipped on disk, then
+                SIGKILL -> restart falls back to the prior verified step
+                (manifest verification + lineage walk); ckpt_doctor must
+                flag exactly the injected-corrupt step
 
 Usage:
 
@@ -34,11 +39,14 @@ import argparse
 import json
 import os
 import re
+import signal
 import subprocess
 import sys
 from dataclasses import dataclass, field
+from typing import Callable, Optional
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from picotron_tpu.resilience import (  # noqa: E402
     EXIT_PREEMPTED, EXIT_WATCHDOG,
@@ -55,6 +63,11 @@ class Scenario:
     expect_exits: tuple = ()        # nonzero exits the supervisor restarts on
     max_restarts: int = 0           # restart budget (0 = must recover in-run)
     overrides: dict = field(default_factory=dict)  # config section updates
+    # Assertion over save_dir right after the FIRST trainer exit (the
+    # faulted state, before any supervised restart repairs it) — returns
+    # an error string or None. The corruption scenario inspects the
+    # really-corrupted store with ckpt_doctor here.
+    check_after_fault: Optional[Callable] = None
 
 
 SCENARIOS: dict[str, Scenario] = {
@@ -100,7 +113,45 @@ SCENARIOS: dict[str, Scenario] = {
         note="stalled data producer: watchdog stack-dump + exit "
              f"{EXIT_WATCHDOG}, supervisor restart, auto_resume",
     ),
+    "ckpt_corrupt_bitflip": Scenario(
+        # The step-4 periodic save commits (manifest written), a byte in
+        # its largest array payload is flipped on disk, then SIGKILL at
+        # step 5 — a hard crash with a poisoned newest checkpoint. The
+        # restart must NOT trust "finalized": verification fails step 4,
+        # the lineage walk falls back to the verified step-2 save, and
+        # the re-trained run still lands on the baseline's exact final
+        # step/tokens. Saves are synchronous here so the commit (and the
+        # corruption riding it) is ordered strictly before the kill.
+        chaos=f"ckpt_corrupt_bitflip@{STEPS - 2},kill@{STEPS - 1}",
+        expect_exits=(-signal.SIGKILL,),
+        max_restarts=2,
+        overrides={"checkpoint": {"async_save": False}},
+        marker=r"failed verification",
+        note="newest committed checkpoint bit-flipped, then SIGKILL: "
+             "restart verifies, falls back to the prior verified step, "
+             "re-trains to the baseline's final step",
+        check_after_fault=lambda save_dir: _doctor_flags_exactly(
+            save_dir, corrupt_step=STEPS - 2),
+    ),
 }
+
+
+def _doctor_flags_exactly(save_dir: str, corrupt_step: int):
+    """tools/ckpt_doctor.py over the faulted store must flag exactly the
+    injected-corrupt step and pass the rest (the fsck half of the
+    corruption acceptance criteria)."""
+    import ckpt_doctor
+
+    rows = ckpt_doctor.scan(save_dir)
+    bad = [r["step"] for r in rows if r["verdict"] == "corrupt"]
+    good = [r["step"] for r in rows
+            if r["verdict"] in ("verified", "legacy")]
+    if bad != [corrupt_step]:
+        return (f"ckpt_doctor flagged corrupt steps {bad}, expected "
+                f"exactly [{corrupt_step}] (rows: {rows})")
+    if not good:
+        return f"ckpt_doctor found no restorable step besides the corrupt one"
+    return None
 
 
 def scenario_config(workdir: str, chaos_spec: str,
@@ -187,6 +238,12 @@ def run_scenario(name: str, workdir: str, verbose: bool = False) -> bool:
         extra = {} if attempt == 0 else {"PICOTRON_CHAOS": ""}
         rc = _run_trainer(cfg_path, log_path, extra)
         exits.append(rc)
+        if attempt == 0 and sc.check_after_fault is not None:
+            # Inspect the faulted store BEFORE any restart repairs it
+            # (e.g. ckpt_doctor over the really-corrupted lineage).
+            err = sc.check_after_fault(cfg["checkpoint"]["save_dir"])
+            if err:
+                return fail(err)
         if rc == 0:
             break
         if rc not in sc.expect_exits:

@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""fsck for a checkpoint save_dir: verify the full lineage, optionally GC.
+
+Walks every `step_<n>` directory and reports a per-step verdict:
+
+  verified     durable (Orbax-finalized) and every file matches the commit
+               manifest (bytes + content digest)
+  legacy       durable, restorable, but predates commit manifests (no
+               integrity claim beyond "meta.json parses")
+  corrupt      manifest/meta torn, a listed file missing, or bytes/digest
+               mismatch — the failing leaf/file is named
+  not-durable  the save never finalized (crashed/in-flight async write)
+
+Exit code: 0 when no step is corrupt, 1 otherwise — scriptable as a
+post-incident check or a cron'd store audit.
+
+Usage:
+
+  python tools/ckpt_doctor.py SAVE_DIR                # table
+  python tools/ckpt_doctor.py SAVE_DIR --json         # machine-readable
+  python tools/ckpt_doctor.py SAVE_DIR --markdown     # paste into a report
+  python tools/ckpt_doctor.py SAVE_DIR --shallow      # sizes only, no hashing
+  python tools/ckpt_doctor.py SAVE_DIR --gc --keep-last 3 --dry-run
+  python tools/ckpt_doctor.py SAVE_DIR --gc --keep-last 3 --keep-every 1000
+
+GC applies the same retention policy the trainer's in-loop GC uses
+(picotron_tpu/ckpt_integrity.retention_plan) and the same protection: the
+last verified step survives regardless of --keep-last.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from picotron_tpu.checkpoint import CheckpointManager  # noqa: E402
+from picotron_tpu.config import CheckpointConfig, Config  # noqa: E402
+
+
+def _manager(save_dir: str, keep_last: int = 0,
+             keep_every: int = 0) -> CheckpointManager:
+    cfg = Config(checkpoint=CheckpointConfig(
+        save_dir=save_dir, keep_last=keep_last, keep_every=keep_every))
+    return CheckpointManager(cfg, directory=save_dir)
+
+
+def scan(save_dir: str, deep: bool = True,
+         only_step=None) -> list[dict]:
+    """Per-step verdict rows, oldest first. `deep=False` skips content
+    hashing (size/existence checks only — fast triage on huge stores)."""
+    mgr = _manager(save_dir)
+    rows = []
+    for step in mgr.steps():
+        if only_step is not None and step != only_step:
+            continue
+        durable = mgr._is_durable(f"step_{step:08d}")
+        res = mgr.verify_step(step, deep=deep)
+        if res.status == "corrupt":
+            verdict = "corrupt"
+        elif not durable:
+            verdict = "not-durable"
+        else:
+            verdict = res.status  # verified | legacy
+        man = res.manifest or {}
+        rows.append({
+            "step": step,
+            "verdict": verdict,
+            "durable": durable,
+            "files": man.get("file_count"),
+            "bytes": man.get("total_bytes"),
+            "algo": man.get("algo"),
+            "failures": list(res.failures),
+        })
+    return rows
+
+
+def render(rows: list[dict], save_dir: str, markdown: bool = False) -> str:
+    lines = []
+    if markdown:
+        lines.append(f"## ckpt_doctor — `{save_dir}`")
+        lines.append("")
+        lines.append("| step | verdict | files | bytes | failures |")
+        lines.append("|---:|---|---:|---:|---|")
+        for r in rows:
+            fails = "; ".join(r["failures"][:3]) or ""
+            lines.append(f"| {r['step']} | {r['verdict']} | "
+                         f"{r['files'] or ''} | {r['bytes'] or ''} | "
+                         f"{fails} |")
+    else:
+        lines.append(f"[ckpt_doctor] {save_dir}: {len(rows)} step dir(s)")
+        for r in rows:
+            extra = (f"  ({r['files']} files, {r['bytes']} bytes, "
+                     f"{r['algo']})" if r["files"] is not None else "")
+            lines.append(f"  step {r['step']:>8d}  {r['verdict']:<11s}{extra}")
+            for f in r["failures"][:5]:
+                lines.append(f"           !! {f}")
+            if len(r["failures"]) > 5:
+                lines.append(f"           .. and "
+                             f"{len(r['failures']) - 5} more")
+    n_corrupt = sum(r["verdict"] == "corrupt" for r in rows)
+    valid = [r["step"] for r in rows if r["verdict"] in ("verified",
+                                                         "legacy")]
+    tail = (f"{n_corrupt} corrupt, {len(valid)} restorable"
+            + (f", latest valid step {max(valid)}" if valid else ""))
+    lines.append(f"**{tail}**" if markdown else f"[ckpt_doctor] {tail}")
+    return "\n".join(lines)
+
+
+def run_gc(save_dir: str, keep_last: int, keep_every: int,
+           dry_run: bool) -> dict:
+    mgr = _manager(save_dir, keep_last=keep_last, keep_every=keep_every)
+    return mgr.gc(dry_run=dry_run)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        description="verify a checkpoint save_dir's lineage; optional GC")
+    ap.add_argument("save_dir", help="checkpoint directory "
+                    "(contains step_<n> subdirs)")
+    ap.add_argument("--step", type=int, default=None,
+                    help="check only this step")
+    ap.add_argument("--shallow", action="store_true",
+                    help="existence+size checks only (skip content hashing)")
+    fmt = ap.add_mutually_exclusive_group()
+    fmt.add_argument("--json", action="store_true", help="JSON report")
+    fmt.add_argument("--markdown", action="store_true",
+                     help="markdown report")
+    ap.add_argument("--gc", action="store_true",
+                    help="apply the retention policy after the scan")
+    ap.add_argument("--keep-last", type=int, default=3,
+                    help="GC: newest steps to keep (default 3)")
+    ap.add_argument("--keep-every", type=int, default=0,
+                    help="GC: additionally keep steps divisible by this")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="GC: report the plan, delete nothing")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if not os.path.isdir(args.save_dir) and "://" not in args.save_dir:
+        print(f"[ckpt_doctor] no such directory: {args.save_dir}",
+              file=sys.stderr)
+        return 2
+    rows = scan(args.save_dir, deep=not args.shallow, only_step=args.step)
+    gc_result = None
+    if args.gc:
+        if args.keep_last < 1:
+            build_parser().error("--gc needs --keep-last >= 1")
+        gc_result = run_gc(args.save_dir, args.keep_last, args.keep_every,
+                           args.dry_run)
+        if not args.dry_run:  # re-scan: the report shows what survived
+            rows = [r for r in rows if r["step"] in gc_result["kept"]]
+    if args.json:
+        print(json.dumps({"save_dir": args.save_dir, "steps": rows,
+                          "gc": gc_result}, indent=2))
+    else:
+        print(render(rows, args.save_dir, markdown=args.markdown))
+        if gc_result is not None:
+            verb = "would delete" if args.dry_run else "deleted"
+            print(f"[ckpt_doctor] gc: kept {gc_result['kept']}, {verb} "
+                  f"{gc_result['deleted']}")
+    return 1 if any(r["verdict"] == "corrupt" for r in rows) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
