@@ -62,6 +62,23 @@ SWEEP = [
 ]
 
 
+def require_backend(force_cpu: bool) -> None:
+    """Fail fast, with a one-line story, when no accelerator backend comes
+    up — the raw xla_bridge traceback captured in BENCH_r05.json (the axon
+    tunnel down mid-round) is exactly what this replaces. `--cpu` pins the
+    host platform for a structural smoke run instead."""
+    if force_cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.devices()
+    except Exception as e:
+        raise SystemExit(
+            f"bench: no TPU backend reachable "
+            f"(JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '<unset>')}, "
+            f"{type(e).__name__}); rerun with --cpu or fix the tunnel")
+
+
 def bench_config(model: str, layers, seq: int, mbs: int, *,
                  grad_acc: int = 1, remat: bool = True,
                  remat_policy: str = "dots",
@@ -473,7 +490,16 @@ def main() -> None:
                          "16384 for the VERDICT r5 #8 question: is the "
                          "16k bwd-pair excess pipeline overhead the grid "
                          "shape can shrink?); one JSON line per combo")
+    ap.add_argument("--cpu", action="store_true",
+                    help="run on the host CPU platform (structural smoke "
+                         "run, numbers not comparable) instead of failing "
+                         "when no TPU backend is reachable")
     args = ap.parse_args()
+
+    # Backend probe BEFORE any mode: a down TPU tunnel must be one line,
+    # not the xla_bridge traceback BENCH_r05.json recorded. Children of
+    # --sweep inherit the pinned JAX_PLATFORMS via the environment.
+    require_backend(args.cpu)
 
     if args.shardcheck and (args.sweep or args.decode or args.profile
                             or args.bwd_grid_sweep):

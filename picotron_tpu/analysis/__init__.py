@@ -12,6 +12,10 @@ the CLI.
 from picotron_tpu.analysis.collectives import (  # noqa: F401
     CollectiveOp, audit_collectives, parse_collectives,
 )
+from picotron_tpu.analysis.cost_model import (  # noqa: F401
+    Calibration, CostModel, GENERATIONS, StepCost, resolve_generation,
+    spearman,
+)
 from picotron_tpu.analysis.hazards import (  # noqa: F401
     check_donation, check_state_stability, parse_arg_donation,
 )

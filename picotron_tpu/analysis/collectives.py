@@ -192,9 +192,14 @@ def default_gather_budget(cfg, state) -> int:
 
 
 def audit_collectives(cfg, *, text: str = None, state=None,
-                      budget_bytes: int = None, menv=None) -> Report:
+                      budget_bytes: int = None, menv=None,
+                      cost_model=None) -> Report:
     """Audit a config's collective schedule. Pass `text` (+ `state`) to
-    audit an existing lowering; otherwise the train step is lowered here."""
+    audit an existing lowering; otherwise the train step is lowered here.
+    With `cost_model` (analysis/cost_model.CostModel), the parsed ops are
+    additionally priced against the generation's ICI topology and the
+    report's info table gains a `predicted_comm` breakdown — the costed
+    ranking tools/shardcheck.py --cost surfaces."""
     if text is None:
         from picotron_tpu.analysis.trace import lower_train_step
 
@@ -302,4 +307,18 @@ def audit_collectives(cfg, *, text: str = None, state=None,
                         f"of {budget_bytes} bytes — something sharded is "
                         f"being materialized fully replicated")
         rep.info[CHECK]["gather_budget_bytes"] = budget_bytes
+
+    # -- optional ICI cost pricing ----------------------------------------
+    if cost_model is not None:
+        priced = cost_model.price_ops(cfg, eff)
+        by_kind: dict = {}
+        for p in priced:
+            by_kind[p["kind"]] = by_kind.get(p["kind"], 0.0) + p["secs"]
+        rep.info[CHECK]["predicted_comm"] = {
+            "generation": cost_model.gen.name,
+            "total_ms": round(sum(p["secs"] for p in priced) * 1e3, 4),
+            "by_kind_ms": {k: round(v * 1e3, 4)
+                           for k, v in sorted(by_kind.items())},
+            "unattributed_ops": sum(1 for p in priced if p["axis_guess"]),
+        }
     return rep

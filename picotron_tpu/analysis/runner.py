@@ -18,7 +18,8 @@ PREFLIGHT_CHECKS = ("spec", "donation", "stability")
 
 
 def run_shardcheck(cfg, *, menv=None, checks=ALL_CHECKS,
-                   budget_bytes=None, source_roots=None) -> Report:
+                   budget_bytes=None, source_roots=None,
+                   cost_model=None) -> Report:
     """Run the requested analyzers for `cfg`; returns the merged Report.
 
     Host-only: the trace-time checks lower the train step on an abstract
@@ -53,7 +54,8 @@ def run_shardcheck(cfg, *, menv=None, checks=ALL_CHECKS,
 
             rep.extend(audit_collectives(cfg, text=low.text,
                                          state=low.state,
-                                         budget_bytes=budget_bytes))
+                                         budget_bytes=budget_bytes,
+                                         cost_model=cost_model))
         if "donation" in trace_checks:
             from picotron_tpu.analysis.hazards import check_donation
 

@@ -18,6 +18,17 @@ source itself, on paths a given trace never visits. Rules:
   end up inside the jitted step, where a host callback serializes the
   device stream per call — the kind of 10x step-time surprise only a real
   TPU run would otherwise reveal.
+- **loop-collective** (warning): a `lax` collective (`psum`, `all_gather`,
+  `ppermute`, `all_to_all`, ...) issued directly inside a Python
+  `for`/`while` loop. A loop over layers or microbatches that emits one
+  collective per iteration lowers to N small ops where one batched op
+  would do — the unbatched-collective smell the ICI cost model
+  (analysis/cost_model.py) prices per-op α-latency for, and exactly the
+  shape that hides behind "it traced fine". Collectives inside a function
+  *defined* in a loop (a scan body built per-config) do not flag: the
+  loop builds the function once, the scan issues the op. Deliberate
+  unrolled rings (ops/ring_attention.py's cp-hop chain) suppress
+  per-line.
 
 Suppress a finding with a `# shardcheck: ok` comment on the line.
 """
@@ -33,6 +44,11 @@ CHECK = "source_lint"
 
 _HOST_CALLBACKS = {"pure_callback", "io_callback", "callback",
                    "host_callback"}
+
+# lax collectives whose per-iteration issue inside a Python loop is the
+# unbatched-collective smell (see module docstring)
+_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                "all_to_all", "ppermute", "pshuffle", "psum_scatter"}
 
 
 def _attr_chain(node) -> list[str]:
@@ -52,12 +68,47 @@ class _Visitor(ast.NodeVisitor):
         self.relpath = relpath
         self.suppressed = suppressed
         self.rep = rep
+        self._loop_depth = 0
 
     def _add(self, node, severity, message):
         if node.lineno in self.suppressed:
             return
         self.rep.add(CHECK, severity, f"{self.relpath}:{node.lineno}",
                      message)
+
+    # -- loop-collective scope tracking -----------------------------------
+    # A nested function/lambda resets the loop context: defining a scan
+    # body inside a loop is fine — the collective runs once per scan, not
+    # once per Python iteration.
+
+    def _visit_loop(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = visit_While = visit_AsyncFor = _visit_loop
+
+    def _visit_fn(self, node):
+        saved, self._loop_depth = self._loop_depth, 0
+        self.generic_visit(node)
+        self._loop_depth = saved
+
+    visit_FunctionDef = visit_AsyncFunctionDef = visit_Lambda = _visit_fn
+
+    def visit_Call(self, node):
+        chain = _attr_chain(node.func)
+        if (self._loop_depth > 0 and chain
+                and chain[-1] in _COLLECTIVES
+                and chain[0] in ("jax", "lax")):
+            self._add(node, WARNING,
+                      f"collective {'.'.join(chain)} issued inside a "
+                      f"Python loop: one op per iteration where a batched "
+                      f"collective (or a scan) would issue one — the "
+                      f"unbatched-collective smell the ICI cost model "
+                      f"prices per-op latency for. Batch it, move it into "
+                      f"a scan body, or suppress with '# shardcheck: ok' "
+                      f"if the unroll is deliberate")
+        self.generic_visit(node)
 
     def visit_Import(self, node):
         for alias in node.names:

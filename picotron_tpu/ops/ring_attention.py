@@ -166,9 +166,11 @@ def ring_attention(
         out_blk, lse_blk = lax.cond(fully_masked, skip, compute, (q, k, v))
         out_acc, lse_acc = _merge(out_acc, lse_acc, out_blk, lse_blk)
         if step != n - 1:
-            k = lax.ppermute(k, axis, fwd_perm)
-            v = lax.ppermute(v, axis, fwd_perm)
-            kv_positions = lax.ppermute(kv_positions, axis, fwd_perm)
+            # deliberate unroll: ring attention IS one ppermute per hop
+            k = lax.ppermute(k, axis, fwd_perm)  # shardcheck: ok
+            v = lax.ppermute(v, axis, fwd_perm)  # shardcheck: ok
+            kv_positions = lax.ppermute(  # shardcheck: ok
+                kv_positions, axis, fwd_perm)
 
     if return_lse:
         return out_acc.astype(q.dtype), lse_acc
@@ -254,11 +256,13 @@ def ring_attention_bwd_from_saved(
         dk_acc = dk_acc + dk_b
         dv_acc = dv_acc + dv_b
         if step != n - 1:
-            k = lax.ppermute(k, axis, fwd_perm)
-            v = lax.ppermute(v, axis, fwd_perm)
-            kv_positions = lax.ppermute(kv_positions, axis, fwd_perm)
-            dk_acc = lax.ppermute(dk_acc, axis, fwd_perm)
-            dv_acc = lax.ppermute(dv_acc, axis, fwd_perm)
+            # deliberate unroll: one K/V + dK/dV rotation per ring hop
+            k = lax.ppermute(k, axis, fwd_perm)  # shardcheck: ok
+            v = lax.ppermute(v, axis, fwd_perm)  # shardcheck: ok
+            kv_positions = lax.ppermute(  # shardcheck: ok
+                kv_positions, axis, fwd_perm)
+            dk_acc = lax.ppermute(dk_acc, axis, fwd_perm)  # shardcheck: ok
+            dv_acc = lax.ppermute(dv_acc, axis, fwd_perm)  # shardcheck: ok
     # After n-1 rotations this device holds block (my+1) mod n and its
     # accumulated grads; one more forward hop delivers every block's dK/dV
     # back to its owner (n hops total = the identity permutation).

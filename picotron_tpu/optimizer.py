@@ -137,7 +137,9 @@ def _global_sq_norm(grads, clip_specs):
         axes = tuple(a for part in spec if part is not None
                      for a in (part if isinstance(part, (tuple, list))
                                else (part,)))
-        total += lax.psum(s, axes) if axes else s
+        # scalar psums (one fp32 each): latency-only, per-leaf axis sets
+        # differ so they cannot batch into one op
+        total += lax.psum(s, axes) if axes else s  # shardcheck: ok
     return jnp.sqrt(total)
 
 

@@ -200,6 +200,31 @@ def main(argv=None) -> None:
             est = preflight_save_dir(cfg)  # raises RuntimeError w/ story
             log_print(f"checkpoint preflight: ok ({cfg.checkpoint.save_dir}"
                       f", ~{est / 1e9:.2f} GB/checkpoint)")
+        if (cfg.distributed.world_size > 1
+                and os.environ.get("PICOTRON_COST_PREFLIGHT", "1") != "0"):
+            # Advisory layout check (analysis/cost_model + planner): pure
+            # arithmetic, milliseconds even at pod scale. Warn — never
+            # fail — when the chosen layout is predicted >= 20% slower
+            # than the planner's best at the same chip count, with the
+            # overrides line that would close the gap. Threshold via
+            # PICOTRON_COST_GAP (fraction); PICOTRON_COST_PREFLIGHT=0
+            # disables.
+            from picotron_tpu.analysis.cost_model import CostModel
+            from picotron_tpu.analysis.planner import planner_gap
+
+            cm = CostModel(jax.devices()[0].device_kind)
+            cur, best, gap = planner_gap(cfg, cm)
+            gap_bar = float(os.environ.get("PICOTRON_COST_GAP", "0.2"))
+            log_print(f"cost preflight [{cm.gen.name}]: predicted "
+                      f"{cur.total_s * 1e3:.4g} ms/step "
+                      f"({cur.exposed_comm_s * 1e3:.4g} ms exposed comm)")
+            if best is not None and gap >= gap_bar:
+                log_print(
+                    f"cost preflight WARNING: this layout is predicted "
+                    f"{gap * 100:.0f}% slower than the planner's best at "
+                    f"{cfg.distributed.world_size} chips "
+                    f"({best.label}, {best.cost.total_s * 1e3:.4g} "
+                    f"ms/step). To adopt it: {best.overrides_line()}")
 
     n_chips = menv.world_size
     n_params = num_params(cfg.model)

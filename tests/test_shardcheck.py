@@ -366,6 +366,54 @@ def test_source_lint_catches_planted_violations(tmp_path):
     assert not any(":9" in f.path for f in rep.findings)
 
 
+def test_source_lint_flags_collective_in_python_loop(tmp_path):
+    """The unbatched-collective smell: a lax collective issued once per
+    Python loop iteration (over layers/microbatches) flags as a warning;
+    the same collective inside a function *defined* in the loop (a scan
+    body) or past a `# shardcheck: ok` does not — the negative half the
+    satellite requires."""
+    bad = tmp_path / "loopy.py"
+    bad.write_text(
+        "import jax\n"
+        "from jax import lax\n"
+        "def per_layer_sync(grads):\n"
+        "    out = []\n"
+        "    for g in grads:\n"
+        "        out.append(lax.psum(g, 'dp'))\n"          # line 6: flags
+        "    return out\n"
+        "def ring(x):\n"
+        "    while True:\n"
+        "        x = jax.lax.ppermute(x, 'cp', [(0, 1)])\n"  # line 10
+        "    return x\n"
+        "def scan_body_built_in_loop(xs):\n"
+        "    fns = []\n"
+        "    for _ in range(4):\n"
+        "        def body(c, x):\n"
+        "            return c, lax.psum(x, 'tp')\n"  # in a fn: no flag
+        "        fns.append(body)\n"
+        "    return fns\n"
+        "def outside(x):\n"
+        "    return lax.psum(x, 'dp')\n"             # no loop: no flag
+        "def deliberate(xs):\n"
+        "    for x in xs:\n"
+        "        lax.ppermute(x, 'cp', [(0, 1)])  # shardcheck: ok\n")
+    rep = lint_sources([str(bad)])
+    assert rep.ok()  # loop-collective is a warning, not an error
+    hits = [f for f in rep.warnings() if "inside a" in f.message]
+    lines = sorted(int(f.path.rsplit(":", 1)[1]) for f in hits)
+    assert lines == [6, 10], rep.render(verbose=True)
+
+
+def test_source_lint_repo_has_no_unsuppressed_loop_collectives():
+    """The deliberate unrolled rings (ops/ring_attention.py) and the
+    per-leaf scalar clip psums (optimizer.py) are suppressed in-line;
+    anything else would be a new smell."""
+    rep = lint_sources()
+    loopy = [f for f in rep.warnings()
+             if "inside a Python loop" in f.message]
+    assert loopy == [], [f.render() for f in loopy]
+
+
 def test_preflight_raises_on_broken_spec(monkeypatch):
     """train.py wiring: a mutilated param_specs must abort with a
     ShardcheckError whose text carries the path-level finding."""

@@ -360,6 +360,70 @@ def test_telemetry_report_cli_markdown_smoke(tmp_path, capsys):
     assert tr.main([str(tmp_path / "empty.jsonl")]) == 1
 
 
+def test_telemetry_report_comm_row(tmp_path, capsys):
+    """--config adds the `comm` row: the ICI cost model's predicted comm
+    time next to the measured sync-phase median, so calibration drift is
+    visible per run."""
+    import json
+
+    events = [
+        {"ts": 1.0, "kind": "phase", "phase": "step", "step": 1,
+         "category": "compute", "secs": 0.5},
+        {"ts": 1.5, "kind": "phase", "phase": "sync", "step": 1,
+         "category": "logging", "secs": 0.02},
+        {"ts": 2.0, "kind": "phase", "phase": "step", "step": 2,
+         "category": "compute", "secs": 0.5},
+        {"ts": 2.5, "kind": "phase", "phase": "sync", "step": 2,
+         "category": "logging", "secs": 0.03},
+    ]
+    with open(tmp_path / "telemetry.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps({
+        "distributed": {"dp_size": 2, "tp_size": 2},
+        "model": {"name": "debug-tiny"},
+        "training": {"seq_length": 64, "micro_batch_size": 1,
+                     "gradient_accumulation_steps": 2},
+    }))
+
+    tr = load_tool("telemetry_report")
+    assert tr.main([str(tmp_path), "--config", str(cfg_path),
+                    "--generation", "v5e", "--json"]) == 0
+    row = json.loads(capsys.readouterr().out)
+    comm = row["comm"]
+    assert comm["generation"] == "v5e"
+    assert comm["predicted_comm_ms"] > 0
+    assert comm["measured_sync_p50_ms"] == 30.0
+    assert "comm_drift_pct" in comm
+    # text render carries the row too
+    assert tr.main([str(tmp_path), "--config", str(cfg_path)]) == 0
+    assert "comm [v5e]: predicted" in capsys.readouterr().out
+    # without --config the row is absent (no silent v5e default)
+    assert tr.main([str(tmp_path), "--json"]) == 0
+    assert "comm" not in json.loads(capsys.readouterr().out)
+
+
+def test_bench_fails_fast_without_tpu_backend():
+    """The satellite: a down TPU tunnel must yield ONE actionable line
+    ('no TPU backend reachable ... rerun with --cpu or fix the tunnel'),
+    not the raw xla_bridge traceback BENCH_r05.json captured. Forces a
+    backend that cannot initialize in a fresh interpreter."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cuda")
+    env.pop("XLA_FLAGS", None)  # the conftest CPU forcing must not leak
+    res = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "..", "bench.py"),
+         "--steps", "1"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert res.returncode == 1
+    assert "no TPU backend reachable" in res.stderr
+    assert "rerun with --cpu or fix the tunnel" in res.stderr
+    assert "Traceback" not in res.stderr
+
+
 def test_shardcheck_cli_smoke(capsys):
     """tools/shardcheck.py end-to-end on the CPU backend: preset
     resolution, the full analyzer stack, and the JSON output contract

@@ -108,6 +108,15 @@ def main(argv=None) -> int:
                     help="one JSON line per config instead of the report")
     ap.add_argument("--verbose", action="store_true",
                     help="include info-level findings and summary tables")
+    ap.add_argument("--cost", action="store_true",
+                    help="price the traced collective schedule with the "
+                         "ICI cost model and compare the config against "
+                         "the layout planner's best at equal chip count "
+                         "(picotron_tpu/analysis/cost_model.py)")
+    ap.add_argument("--generation", default="v5e",
+                    choices=["v4", "v5e", "v5p", "v6e"],
+                    help="TPU generation for --cost (ICI bandwidth, "
+                         "topology, HBM)")
     args = ap.parse_args(argv)
 
     names = list(args.preset) + (sorted(PRESETS) if args.all_presets
@@ -142,9 +151,30 @@ def main(argv=None) -> int:
 
     jax.config.update("jax_platforms", "cpu")
 
+    cost_model = None
+    if args.cost:
+        from picotron_tpu.analysis.cost_model import CostModel
+
+        cost_model = CostModel(args.generation)
+
     n_bad = 0
     for label, cfg in targets:
-        rep = run_shardcheck(cfg, checks=checks, budget_bytes=budget)
+        rep = run_shardcheck(cfg, checks=checks, budget_bytes=budget,
+                             cost_model=cost_model)
+        cost_row = None
+        if cost_model is not None:
+            from picotron_tpu.analysis.planner import planner_gap
+
+            cur, best, gap = planner_gap(cfg, cost_model)
+            cost_row = {
+                "generation": cost_model.gen.name,
+                "predicted_step_ms": round(cur.total_s * 1e3, 3),
+                "exposed_comm_ms": round(cur.exposed_comm_s * 1e3, 3),
+                "planner_best": best.label if best else None,
+                "planner_best_step_ms": (round(best.cost.total_s * 1e3, 3)
+                                         if best else None),
+                "gap_vs_best_pct": round(gap * 100, 1),
+            }
         n_bad += 0 if rep.ok() else 1
         if args.json:
             print(json.dumps({
@@ -155,10 +185,22 @@ def main(argv=None) -> int:
                 "findings": [f.render() for f in rep.findings
                              if f.severity != "info" or args.verbose],
                 "info": rep.info,
+                **({"cost": cost_row} if cost_row else {}),
             }), flush=True)
         else:
             print(f"== {label} ==")
             print(rep.render(verbose=args.verbose), flush=True)
+            if cost_row:
+                line = (f"cost[{cost_row['generation']}]: predicted step "
+                        f"{cost_row['predicted_step_ms']} ms (exposed "
+                        f"comm {cost_row['exposed_comm_ms']} ms)")
+                if cost_row["planner_best"]:
+                    line += (f"; planner best at equal chips: "
+                             f"{cost_row['planner_best']} "
+                             f"({cost_row['planner_best_step_ms']} ms, "
+                             f"this config "
+                             f"+{cost_row['gap_vs_best_pct']}%)")
+                print(line, flush=True)
     if not args.json:
         status = "green" if n_bad == 0 else f"{n_bad} config(s) with errors"
         print(f"shardcheck: {len(targets)} config(s) checked — {status}")

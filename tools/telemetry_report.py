@@ -157,6 +157,39 @@ def summarize(events: list[dict]) -> dict:
     return out
 
 
+def comm_row(events: list[dict], config_path: str,
+             generation: str) -> dict:
+    """Predicted vs measured per-step communication time: the ICI cost
+    model's exposed-comm prediction for the run's config next to the
+    measured sync-phase median from the stream. The drift column is the
+    per-run calibration residual — when it grows, refit (see
+    picotron_tpu/analysis/calibration.py and the README calibration
+    protocol). Pure arithmetic: no devices are touched."""
+    from picotron_tpu.analysis.calibration import measured_step_seconds
+    from picotron_tpu.analysis.cost_model import CostModel
+    from picotron_tpu.config import load_config
+
+    cfg = load_config(config_path)
+    cost = CostModel(generation).predict(cfg)
+    meas = measured_step_seconds(events) or {}
+    out = {
+        "generation": cost.generation,
+        "predicted_comm_ms": round(cost.exposed_comm_s * 1e3, 3),
+        "predicted_step_ms": round(cost.total_s * 1e3, 3),
+        "measured_sync_p50_ms": (round(meas["sync_s"] * 1e3, 3)
+                                 if meas.get("sync_s") is not None
+                                 else None),
+        "measured_step_p50_ms": (round(meas["step_s"] * 1e3, 3)
+                                 if meas.get("step_s") is not None
+                                 else None),
+    }
+    if out["measured_sync_p50_ms"] and out["predicted_comm_ms"]:
+        out["comm_drift_pct"] = round(
+            100.0 * (out["measured_sync_p50_ms"]
+                     / out["predicted_comm_ms"] - 1.0), 1)
+    return out
+
+
 def render(s: dict, markdown: bool = False) -> str:
     lines = []
     gp = s["goodput_pct"]
@@ -196,6 +229,16 @@ def render(s: dict, markdown: bool = False) -> str:
                          f"{p['total_s']:10.3f}s  p50 {p['p50_ms']:.2f}ms  "
                          f"p95 {p['p95_ms']:.2f}ms")
     lines.append("")
+    cm = s.get("comm")
+    if cm:
+        drift = cm.get("comm_drift_pct")
+        msg = (f"comm [{cm['generation']}]: predicted "
+               f"{cm['predicted_comm_ms']} ms/step exposed "
+               f"(of {cm['predicted_step_ms']} ms predicted step) | "
+               f"measured sync p50 {cm['measured_sync_p50_ms']} ms"
+               + (f" | drift {drift:+.1f}%" if drift is not None else ""))
+        lines.append(f"**{msg}**" if markdown else msg)
+        lines.append("")
     ev = ", ".join(f"{k}={v}" for k, v in s["events"].items())
     lines.append(f"events: {ev}" if not markdown else f"**events:** {ev}")
     tr = s.get("training")
@@ -218,6 +261,14 @@ def main(argv=None) -> int:
                     help="emit markdown tables (PERF.md format)")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object")
+    ap.add_argument("--config", default=None,
+                    help="the run's config JSON: adds a `comm` row — the "
+                         "ICI cost model's predicted per-step comm time "
+                         "next to the measured sync-phase time, so "
+                         "calibration drift is visible per run")
+    ap.add_argument("--generation", default="v5e",
+                    choices=["v4", "v5e", "v5p", "v6e"],
+                    help="TPU generation for --config's comm prediction")
     args = ap.parse_args(argv)
 
     events = load_events(resolve_path(args.path))
@@ -225,6 +276,8 @@ def main(argv=None) -> int:
         print(f"no events in {args.path}", file=sys.stderr)
         return 1
     s = summarize(events)
+    if args.config:
+        s["comm"] = comm_row(events, args.config, args.generation)
     try:
         print(json.dumps(s) if args.json else render(s, args.markdown))
     except BrokenPipeError:  # `... | head` is a supported way to read this
