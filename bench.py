@@ -311,6 +311,158 @@ def run_decode(model: str, layers, prompt_len: int, max_new: int,
     }
 
 
+def make_serve_trace(n_requests: int, rate: float, prompt_len: int,
+                     max_new: int, vocab: int, seed: int = 0) -> list:
+    """Synthetic arrival trace: mixed prompt lengths in
+    [prompt_len/8, prompt_len], mixed output budgets in
+    [max_new/8, max_new] (wide spread — real traffic is heavy-tailed,
+    and the spread is precisely what continuous batching monetizes),
+    Poisson arrivals at `rate` req/s (rate <= 0 = everything arrives at
+    t=0 — the saturation/throughput trace; a finite rate exercises
+    queue_wait under load). Deterministic per seed, so serve and
+    baseline always score the same workload."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    out, t = [], 0.0
+    for _ in range(n_requests):
+        plen = int(rng.integers(max(prompt_len // 8, 1), prompt_len + 1))
+        olen = int(rng.integers(max(max_new // 8, 1), max_new + 1))
+        prompt = rng.integers(0, vocab, size=plen).tolist()
+        if rate > 0:
+            t += float(rng.exponential(1.0 / rate))
+        out.append((prompt, olen, t))
+    return out
+
+
+def run_serve(model: str, layers, *, slots: int, block_size: int,
+              num_blocks: int, prefill_chunk: int, prompt_len: int,
+              max_new: int, n_requests: int, rate: float, tp: int = 1,
+              decode_interval: int = 4, seed: int = 0,
+              telemetry: str | None = None) -> dict:
+    """Continuous batching + paged KV cache (picotron_tpu/serve) against
+    the batch-static `generate` baseline, on the same synthetic arrival
+    trace. One JSON line: serving tokens/s as the headline value,
+    `vs_static` as the continuous-batching win (ragged lengths stop
+    costing max-length decode steps; finished slots refill instead of
+    idling), plus the SLO view (p50/p95 TTFT, per-token latency, queue
+    wait) and engine health (slot occupancy, pool utilization,
+    preemptions, decode compiles — the last must be 1).
+
+    Both sides are timed compile-warm: a 2-request mini-trace warms the
+    engine's two programs (same static shapes as the real trace) and one
+    throwaway generate call warms the baseline's; rate > 0 makes the
+    engine wall include arrival gaps, so use the default rate=0
+    saturation trace for vs_static anchors."""
+    import numpy as np
+
+    from picotron_tpu.config import ModelConfig, ServeConfig, resolve_preset
+    from picotron_tpu.generate import generate, place_for_decode
+    from picotron_tpu.models.llama import init_params
+    from picotron_tpu.serve import ServeEngine
+    from picotron_tpu.telemetry import JsonlSink, Telemetry
+
+    cap = prompt_len + max_new
+    preset = resolve_preset(model)
+    preset["max_position_embeddings"] = max(
+        preset.get("max_position_embeddings", 0), cap)
+    if layers:
+        preset["num_hidden_layers"] = layers
+    mcfg = ModelConfig(name=model, **preset)
+    params = jax.jit(
+        lambda k: jax.tree.map(lambda x: x.astype(jnp.bfloat16),
+                               init_params(mcfg, k)))(jax.random.key(0))
+    if tp > 1:
+        # tp=1 placement is semantically a no-op but COMMITS the tree,
+        # which pins every jit variant to explicit shardings — skipping
+        # it keeps the single-chip path on the fast uncommitted dispatch
+        params = place_for_decode(params, mcfg, tp=tp)
+    scfg = ServeConfig(decode_slots=slots, block_size=block_size,
+                       num_blocks=num_blocks, prefill_chunk=prefill_chunk,
+                       max_model_len=cap, decode_interval=decode_interval)
+    trace = make_serve_trace(n_requests, rate, prompt_len, max_new,
+                             mcfg.vocab_size, seed)
+    useful_tokens = sum(olen for _, olen, _ in trace)
+
+    # compile-warm both programs (decode shape = slot count, prefill
+    # shape = chunk size — both identical to the real trace's)
+    warm = ServeEngine(params, mcfg, scfg)
+    warm.run([(trace[0][0], 2), (trace[1 % len(trace)][0], 2)])
+    warm.close()
+
+    tel = (Telemetry(sinks=[JsonlSink(telemetry)]) if telemetry else None)
+    eng = ServeEngine(params, mcfg, scfg, telemetry=tel)
+    t0 = time.perf_counter()
+    eng.run(trace)
+    serve_wall = time.perf_counter() - t0
+    summary = eng.summary
+    eng.close()
+    if tel is not None:
+        tel.close()
+
+    # batch-static baseline: ceil(N/slots) generate() batches in arrival
+    # order, every prompt right-padded to the trace max and every batch
+    # decoding the trace-max budget (the shapes a static offline sampler
+    # is stuck with) — one warm-up call, then timed end to end
+    p_max = max(len(p) for p, _, _ in trace)
+    o_max = max(olen for _, olen, _ in trace)
+    groups = [trace[i:i + slots] for i in range(0, len(trace), slots)]
+
+    def static_batch(group):
+        ids = np.zeros((slots, p_max), np.int32)
+        for j, (p, _, _) in enumerate(group):
+            ids[j, :len(p)] = p
+        return jnp.asarray(ids)
+
+    np.asarray(generate(params, mcfg, static_batch(groups[0]), o_max))
+    t0 = time.perf_counter()
+    for g in groups:
+        np.asarray(generate(params, mcfg, static_batch(g), o_max))
+    static_wall = time.perf_counter() - t0
+
+    serve_tps = useful_tokens / serve_wall
+    static_tps = useful_tokens / static_wall
+    tp_tag = f"-tp{tp}" if tp > 1 else ""
+    ms = lambda v: round(v * 1e3, 2) if v is not None else None  # noqa: E731
+    return {
+        "metric": f"serve_{model.split('/')[-1]}"
+                  f"-{mcfg.num_hidden_layers}L{tp_tag}",
+        "value": round(serve_tps, 1),
+        "unit": "serve_tokens_per_sec",
+        "vs_static": round(serve_tps / static_tps, 3),
+        "static_tokens_per_sec": round(static_tps, 1),
+        "requests": n_requests,
+        "arrival_rate": rate,
+        "useful_tokens": useful_tokens,
+        "prompt_len_max": p_max,
+        "max_new_max": o_max,
+        "slots": slots,
+        "block_size": block_size,
+        "num_blocks": summary["num_blocks"],
+        "prefill_chunk": prefill_chunk,
+        "tp": tp,
+        "ttft_p50_ms": ms(summary["ttft_p50_s"]),
+        "ttft_p95_ms": ms(summary["ttft_p95_s"]),
+        "token_latency_p50_ms": ms(summary["token_latency_p50_s"]),
+        "token_latency_p95_ms": ms(summary["token_latency_p95_s"]),
+        "queue_wait_p50_ms": ms(summary["queue_wait_p50_s"]),
+        "queue_wait_p95_ms": ms(summary["queue_wait_p95_s"]),
+        "slot_occupancy": summary["slot_occupancy"],
+        "pool_peak_utilization": summary["pool_peak_utilization"],
+        "preemptions": summary["preemptions"],
+        "decode_steps": summary["decode_steps"],
+        "decode_compiles": summary["decode_compiles"],
+        # structural comparison, independent of host-load noise: decode
+        # steps each side burns per slot (the engine stops paying for
+        # retired/ragged sequences; the static sampler decodes the trace
+        # max for every batch) — continuous batching must be strictly
+        # lower on any ragged trace
+        "decode_slot_steps": summary["decode_steps"] * decode_interval,
+        "static_decode_slot_steps": len(groups) * o_max,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
 def run_bwd_grid_sweep(model: str, seq: int, batch: int, steps: int = 5,
                        blocks=None) -> list:
     """Block-size sweep of the flash attention KERNEL PAIR (fwd, fwd+bwd)
@@ -484,6 +636,34 @@ def main() -> None:
                          "KV cache) over N chips with the training TP "
                          "layout (generate.place_for_decode) — the "
                          "7B-scale decode arrangement")
+    ap.add_argument("--serve", action="store_true",
+                    help="measure the serving stack (picotron_tpu/serve: "
+                         "continuous batching + paged KV cache) on a "
+                         "synthetic arrival trace vs the batch-static "
+                         "generate baseline: tokens/s, p50/p95 TTFT, "
+                         "per-token latency, slot/pool utilization")
+    ap.add_argument("--requests", type=int, default=16,
+                    help="--serve: requests in the synthetic trace")
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="--serve: Poisson arrival rate in requests/s "
+                         "(0 = all arrive at t=0, the saturation trace "
+                         "the vs_static anchor uses)")
+    ap.add_argument("--serve-slots", type=int, default=8,
+                    help="--serve: in-flight decode batch width (the one "
+                         "static shape of the decode program)")
+    ap.add_argument("--block-size", type=int, default=16,
+                    help="--serve: tokens per paged-cache block")
+    ap.add_argument("--num-blocks", type=int, default=0,
+                    help="--serve: physical blocks in the shared KV pool "
+                         "(0 = worst-case auto: slots * ceil(cap/block); "
+                         "set lower to exercise preemption)")
+    ap.add_argument("--prefill-chunk", type=int, default=64,
+                    help="--serve: prompt tokens prefilled per engine "
+                         "iteration, interleaved 1:1 with decode steps")
+    ap.add_argument("--decode-interval", type=int, default=4,
+                    help="--serve: decode steps scanned inside one "
+                         "dispatch (amortizes host overhead; retirement "
+                         "latency quantizes to it)")
     ap.add_argument("--bwd-grid-sweep", action="store_true",
                     help="sweep flash-attention (block_q, block_k) over "
                          "the fwd / fwd+bwd kernel pair at --seq (use "
@@ -502,9 +682,26 @@ def main() -> None:
     require_backend(args.cpu)
 
     if args.shardcheck and (args.sweep or args.decode or args.profile
-                            or args.bwd_grid_sweep):
+                            or args.bwd_grid_sweep or args.serve):
         ap.error("--shardcheck is its own mode; incompatible with "
-                 "--sweep/--decode/--profile/--bwd-grid-sweep")
+                 "--sweep/--decode/--profile/--bwd-grid-sweep/--serve")
+
+    if args.serve:
+        if args.sweep or args.decode or args.profile or args.bwd_grid_sweep:
+            ap.error("--serve is its own mode; incompatible with "
+                     "--sweep/--decode/--profile/--bwd-grid-sweep")
+        if args.max_new_tokens < 1 or args.requests < 2:
+            ap.error("--serve needs --max-new-tokens >= 1 and "
+                     "--requests >= 2")
+        print(json.dumps(run_serve(
+            args.model, args.layers or 0, slots=args.serve_slots,
+            block_size=args.block_size, num_blocks=args.num_blocks,
+            prefill_chunk=args.prefill_chunk, prompt_len=args.prompt_len,
+            max_new=args.max_new_tokens, n_requests=args.requests,
+            rate=args.rate, tp=args.tp,
+            decode_interval=args.decode_interval,
+            telemetry=args.telemetry)))
+        return
 
     if args.bwd_grid_sweep:
         if args.sweep or args.decode or args.profile:

@@ -643,6 +643,63 @@ class ResilienceConfig:
 
 
 @dataclass(frozen=True)
+class ServeConfig:
+    """Serving stack (picotron_tpu/serve): continuous batching + paged KV
+    cache on the decode path. Sizing contract: per-slot capacity is
+    max_model_len tokens (table width = ceil(max_model_len / block_size));
+    the POOL is num_blocks fixed-size blocks shared by every slot — cache
+    HBM scales with num_blocks, not decode_slots x max_model_len, which is
+    the whole point (ragged request lengths stop stranding cache memory).
+    Oversubscribe deliberately: the scheduler preempts youngest-first when
+    the pool runs dry."""
+
+    # In-flight decode batch width: the ONE static shape the decode step
+    # is compiled for (slots are refilled mid-flight, never reshaped).
+    decode_slots: int = 8
+    # Tokens per physical cache block. Smaller = less fragmentation waste
+    # per sequence (at most block_size - 1 slots), larger = smaller block
+    # tables and fewer scatter indices.
+    block_size: int = 16
+    # Physical blocks in the shared pool. 0 = auto: decode_slots *
+    # ceil(max_model_len / block_size) — the no-oversubscription worst
+    # case (same HBM as a contiguous cache at max length). Set it
+    # explicitly to actually bank the paged-cache memory win.
+    num_blocks: int = 0
+    # Prompt tokens prefilled per engine iteration; one chunk interleaves
+    # with each decode step so a long prompt cannot stall in-flight
+    # decodes. Also the prefill program's static shape (prompts pad to a
+    # chunk multiple; padded positions are sentinel-dropped).
+    prefill_chunk: int = 64
+    # Per-sequence capacity (prompt + generated). 0 = the model's
+    # max_position_embeddings.
+    max_model_len: int = 0
+    # Decode steps run INSIDE one dispatch (a lax.scan over the decode
+    # step, with in-flight EOS forcing identical to generate.py's scan):
+    # amortizes the per-dispatch host overhead over this many tokens per
+    # slot. The scheduler only sees tokens every interval, so admission/
+    # retirement latency quantizes to it and a request that hits EOS or
+    # its budget mid-interval pays the leftover steps as padding — keep
+    # it small (2-8) for interactive SLOs, 1 for exact per-token
+    # scheduling.
+    decode_interval: int = 4
+
+    def validate(self) -> None:
+        for name in ("decode_slots", "block_size", "prefill_chunk",
+                     "decode_interval"):
+            if getattr(self, name) < 1:
+                raise ValueError(
+                    f"serve.{name} must be >= 1, got {getattr(self, name)}")
+        if self.num_blocks < 0:
+            raise ValueError(
+                f"serve.num_blocks must be >= 0 (0 = auto), got "
+                f"{self.num_blocks}")
+        if self.max_model_len < 0:
+            raise ValueError(
+                f"serve.max_model_len must be >= 0 (0 = model limit), got "
+                f"{self.max_model_len}")
+
+
+@dataclass(frozen=True)
 class LoggingConfig:
     """(ref: template/base_config.json:41-45)."""
 
@@ -678,6 +735,7 @@ class Config:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
     # -- derived quantities (ref: data.py:17-20) --
 
@@ -699,6 +757,12 @@ class Config:
         self.distributed.validate()
         self.model.validate()
         self.resilience.validate()
+        self.serve.validate()
+        if self.serve.max_model_len > self.model.max_position_embeddings:
+            raise ValueError(
+                f"serve.max_model_len ({self.serve.max_model_len}) exceeds "
+                f"max_position_embeddings "
+                f"({self.model.max_position_embeddings})")
         d, m, t = self.distributed, self.model, self.training
         ck = self.checkpoint
         if ck.keep_last < 0 or ck.keep_every < 0:
@@ -939,6 +1003,7 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
         checkpoint=CheckpointConfig(**_filter_kwargs(CheckpointConfig, raw.get("checkpoint", {}))),
         logging=LoggingConfig(**_filter_kwargs(LoggingConfig, raw.get("logging", {}))),
         resilience=ResilienceConfig(**_filter_kwargs(ResilienceConfig, raw.get("resilience", {}))),
+        serve=ServeConfig(**_filter_kwargs(ServeConfig, raw.get("serve", {}))),
     )
     cfg.validate()
     return cfg

@@ -55,10 +55,38 @@ from picotron_tpu.ops.rope import apply_rope
 
 
 class KVCache(NamedTuple):
-    """Per-layer key/value cache, [L, B, S_max, Hkv, D] each."""
+    """Per-layer contiguous key/value cache, [L, B, S_max, Hkv, D] each.
+
+    One of the two cache implementations `_decode_layers` runs against
+    (the other is `serve.paged_cache.PagedKVCache`); both expose the same
+    interface — `num_layers`, `write(li, k, v, q_pos)`,
+    `layer_view(li)` — so the layer loop is cache-agnostic and greedy
+    parity between the two is a test invariant, not an accident."""
 
     k: jnp.ndarray
     v: jnp.ndarray
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    def write(self, li, k_new, v_new, q_pos) -> "KVCache":
+        """Write this segment's K/V [B, s, Hkv, D] into slots
+        q_pos[0]..q_pos[-1] of layer li. Contiguous slots only: needs the
+        batch-shared [s] positions form (every sequence at the same
+        offset — the offline `generate` arrangement)."""
+        start = q_pos[0]
+        ck = lax.dynamic_update_slice(self.k, k_new[None],
+                                      (li, 0, start, 0, 0))
+        cv = lax.dynamic_update_slice(self.v, v_new[None],
+                                      (li, 0, start, 0, 0))
+        return KVCache(ck, cv)
+
+    def layer_view(self, li):
+        """([B, S_max, Hkv, D], same) view of layer li, slot j holding
+        the token at position j."""
+        return (lax.dynamic_index_in_dim(self.k, li, 0, keepdims=False),
+                lax.dynamic_index_in_dim(self.v, li, 0, keepdims=False))
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_length: int) -> KVCache:
@@ -68,11 +96,29 @@ def init_cache(cfg: ModelConfig, batch: int, max_length: int) -> KVCache:
     return KVCache(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
 
 
+def _rope(x, cos, sin, q_pos):
+    """apply_rope over either positions form: [s] (batch-shared — the
+    offline path) or [B, s] (per-sequence — continuous batching, where
+    every slot sits at its own depth). Negative positions (chunk padding
+    in the serving prefill) rotate by position 0; their K/V never lands
+    in a cache (sentinel-dropped) and their outputs are discarded."""
+    if q_pos.ndim == 1:
+        return apply_rope(x, cos, sin, jnp.maximum(q_pos, 0))
+    c = cos[jnp.maximum(q_pos, 0)][:, :, None, :]  # [B, s, 1, D/2]
+    s_ = sin[jnp.maximum(q_pos, 0)][:, :, None, :]
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * c - x2 * s_, x2 * c + x1 * s_],
+                           axis=-1).astype(x.dtype)
+
+
 def _cached_attention(q, ck, cv, q_pos):
-    """q: [B, s, Hq, D] at global positions q_pos [s]; ck/cv: [B, S_max,
-    Hkv, D] with slot j holding the token at position j (zeros beyond the
-    filled length — masked out by causality, since every filled slot index
-    <= max(q_pos)). Returns [B, s, Hq, D]."""
+    """q: [B, s, Hq, D] at global positions q_pos ([s] batch-shared or
+    [B, s] per-sequence); ck/cv: [B, S_max, Hkv, D] with slot j holding
+    the token at position j (zeros/stale beyond the filled length —
+    masked out by causality, since every filled slot index <= max(q_pos);
+    exact zeros under softmax leave the valid rows bit-identical for any
+    S_max). Returns [B, s, Hq, D]."""
     b, s, hq, d = q.shape
     s_max, hkv = ck.shape[1], ck.shape[2]
     group = hq // hkv
@@ -80,42 +126,45 @@ def _cached_attention(q, ck, cv, q_pos):
     # [B, Hkv, G, s, S_max]
     scores = jnp.einsum("bshgd,bthd->bhgst", qg, ck).astype(jnp.float32)
     scores = scores / (d ** 0.5)
-    mask = jnp.arange(s_max)[None, :] <= q_pos[:, None]  # [s, S_max]
-    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    # negative q_pos (serving chunk padding) clamps to 0 so the row stays
+    # finite (an all-masked row softmaxes to NaN and poisons the residual
+    # stream for positions whose output IS discarded, but which still
+    # flows through later layers)
+    mask = jnp.arange(s_max) <= jnp.maximum(q_pos, 0)[..., None]
+    if mask.ndim == 2:          # [s, S_max] batch-shared
+        mask = mask[None]
+    mask = mask[:, None, None]  # [B|1, 1, 1, s, S_max]
+    scores = jnp.where(mask, scores, -jnp.inf)
     p = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bhgst,bthd->bshgd", p, cv)
     return out.reshape(b, s, hq, d)
 
 
-def _decode_layers(params, x, cache: KVCache, q_pos, cfg: ModelConfig,
-                   cos, sin):
+def _decode_layers(params, x, cache, q_pos, cfg: ModelConfig, cos, sin):
     """Run every layer over x [B, s, H] (prefill: s = prompt length,
-    decode: s = 1), writing this segment's K/V into the cache at slots
-    q_pos[0]..q_pos[-1]. Returns (hidden, cache)."""
+    decode: s = 1), writing this segment's K/V into the cache at positions
+    q_pos. Cache-agnostic: `cache` is any object with num_layers /
+    write / layer_view (contiguous KVCache here, PagedKVCache in
+    picotron_tpu/serve). Returns (hidden, cache)."""
     dt = x.dtype
     d = cfg.head_dim
-    start = q_pos[0]
 
-    # The stacked cache rides the scan CARRY with per-layer
-    # dynamic-update-slices of only the new token slots. Feeding it
-    # through as xs/ys instead (r4 structure) made every decode step
-    # rewrite the full cache — the scan stacks fresh ys buffers — and the
-    # token-loop carry copy doubled it: profiled at 2x 2.75 ms of pure
-    # cache copies per token at SmolLM-1.7B batch 8 (~half the decode
-    # step; PERF.md r5). Carry + in-place dus writes only the s new
-    # slots per layer.
+    # The cache rides the scan CARRY with per-layer in-place writes of
+    # only the new token slots. Feeding it through as xs/ys instead (r4
+    # structure) made every decode step rewrite the full cache — the scan
+    # stacks fresh ys buffers — and the token-loop carry copy doubled it:
+    # profiled at 2x 2.75 ms of pure cache copies per token at
+    # SmolLM-1.7B batch 8 (~half the decode step; PERF.md r5).
     def body(carry, inputs):
-        x, ck, cv = carry
+        x, cache = carry
         lp, li = inputs
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         b, s, _ = h.shape
         q, k, v = qkv_proj(h, lp, d)
-        q = apply_rope(q, cos, sin, q_pos)
-        k = apply_rope(k, cos, sin, q_pos)
-        ck = lax.dynamic_update_slice(ck, k[None], (li, 0, start, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v[None], (li, 0, start, 0, 0))
-        ck_l = lax.dynamic_index_in_dim(ck, li, 0, keepdims=False)
-        cv_l = lax.dynamic_index_in_dim(cv, li, 0, keepdims=False)
+        q = _rope(q, cos, sin, q_pos)
+        k = _rope(k, cos, sin, q_pos)
+        cache = cache.write(li, k, v, q_pos)
+        ck_l, cv_l = cache.layer_view(li)
         out = _cached_attention(q, ck_l, cv_l, q_pos)
         out = out.reshape(b, s, -1) @ lp["o"].astype(dt)
         x = x + out
@@ -123,13 +172,12 @@ def _decode_layers(params, x, cache: KVCache, q_pos, cfg: ModelConfig,
             mlp_out, _ = _moe_block(x, lp, cfg, DEFAULT_CTX)
         else:
             mlp_out = _mlp_block(x, lp, cfg, DEFAULT_CTX)
-        return (x + mlp_out, ck, cv), None
+        return (x + mlp_out, cache), None
 
-    n_layers = cache.k.shape[0]
-    (x, ck, cv), _ = lax.scan(
-        body, (x, cache.k, cache.v),
-        (params["layers"], jnp.arange(n_layers)))
-    return x, KVCache(ck, cv)
+    (x, cache), _ = lax.scan(
+        body, (x, cache),
+        (params["layers"], jnp.arange(cache.num_layers)))
+    return x, cache
 
 
 def _logits_last(params, x, cfg: ModelConfig):
@@ -171,8 +219,7 @@ def _generate_jit(params, prompt_ids, cfg: ModelConfig,
     done = (jnp.full((b,), False) if eos_token_id is None
             else tok == eos_token_id)
 
-    def step(carry, i):
-        tok, cache, done, key = carry
+    def decode_one(tok, cache, key, i):
         # iteration i feeds the token SAMPLED at step i-1, which sits at
         # sequence position p_len + i - 1 (an off-by-one here rotates RoPE
         # wrong, writes K/V one slot late, and attends a never-written
@@ -183,15 +230,43 @@ def _generate_jit(params, prompt_ids, cfg: ModelConfig,
         logits = _logits_last(params, x, cfg)
         key, sub = jax.random.split(key)
         nxt = _sample(logits, temperature, top_k, sub)
-        if eos_token_id is not None:
+        return nxt, cache, key
+
+    if eos_token_id is None:
+        # no EOS: every step decodes — a fixed-trip scan
+        def step(carry, i):
+            tok, cache, key = carry
+            nxt, cache, key = decode_one(tok, cache, key, i)
+            return (nxt, cache, key), tok
+
+        (last, _, _), toks = lax.scan(
+            step, (tok, cache, key), jnp.arange(1, max_new_tokens))
+        # toks stacks the PREVIOUS token per step; append the final one
+        out = jnp.concatenate([toks.T, last[:, None]], axis=1)  # [B, N]
+    else:
+        # EOS given: a while_loop that stops as soon as EVERY row has
+        # emitted EOS, instead of burning max_new_tokens decode steps on
+        # finished sequences. The output buffer starts EOS-filled, so an
+        # early exit leaves exactly the padding the scan path would have
+        # produced (finished rows are forced to EOS either way) — token
+        # parity between the two paths is pinned by test.
+        out = jnp.full((b, max_new_tokens), eos_token_id, jnp.int32)
+        out = out.at[:, 0].set(tok)
+
+        def cond(carry):
+            i, tok, cache, done, key, out = carry
+            return (i < max_new_tokens) & ~done.all()
+
+        def body(carry):
+            i, tok, cache, done, key, out = carry
+            nxt, cache, key = decode_one(tok, cache, key, i)
             nxt = jnp.where(done, eos_token_id, nxt)
             done = done | (nxt == eos_token_id)
-        return (nxt, cache, done, key), tok
+            out = lax.dynamic_update_slice(out, nxt[:, None], (0, i))
+            return (i + 1, nxt, cache, done, key, out)
 
-    (last, _, _, _), toks = lax.scan(
-        step, (tok, cache, done, key), jnp.arange(1, max_new_tokens))
-    # toks stacks the PREVIOUS token per step; append the final one
-    out = jnp.concatenate([toks.T, last[:, None]], axis=1)  # [B, N]
+        (_, _, _, _, _, out) = lax.while_loop(
+            cond, body, (jnp.asarray(1), tok, cache, done, key, out))
     return jnp.concatenate([prompt_ids, out], axis=1)
 
 

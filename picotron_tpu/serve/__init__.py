@@ -1,0 +1,34 @@
+"""Serving stack: continuous batching + paged KV cache on the decode
+path — the "millions of users, heavy traffic" half of the north star.
+
+- `paged_cache`: block-pool KV cache (fixed-size blocks, per-slot block
+  tables, memory ~ blocks allocated, not batch x max_length) behind the
+  same interface as the offline contiguous `generate.KVCache`.
+- `scheduler`: FIFO admission into a fixed decode-slot batch, chunked
+  prefill, youngest-first preemption with recompute, retirement — pure
+  host logic.
+- `engine`: the driver — two jitted device programs (one decode step,
+  one prefill chunk; each compiled exactly once per serving lifetime)
+  plus telemetry (queue_wait/prefill/decode in the GoodputLedger, TTFT /
+  per-token latency histograms, serve_request/serve_summary JSONL).
+
+Prefill and decode are separate programs on purpose: the planned MPMD
+executor (ROADMAP) can disaggregate them across chips without touching
+this layer.
+"""
+
+from picotron_tpu.serve.engine import ServeEngine
+from picotron_tpu.serve.paged_cache import (
+    BlockPool, PagedKVCache, init_paged_cache,
+)
+from picotron_tpu.serve.scheduler import Request, Scheduler, blocks_for
+
+__all__ = [
+    "BlockPool",
+    "PagedKVCache",
+    "Request",
+    "Scheduler",
+    "ServeEngine",
+    "blocks_for",
+    "init_paged_cache",
+]

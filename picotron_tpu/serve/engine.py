@@ -1,0 +1,554 @@
+"""Serving engine: continuous batching over a paged KV cache.
+
+The host loop owns the scheduler (admission / chunked prefill /
+preemption / retirement, serve/scheduler.py) and drives exactly TWO
+jitted device programs, each compiled once for the whole serving
+lifetime:
+
+- ``decode step``: a fixed batch of `decode_slots` slots, one token per
+  slot per call. Slot count is the static shape; which request occupies
+  which slot, every slot's position, and the block tables are ordinary
+  device DATA, so requests enter and leave mid-flight without a
+  recompile (asserted via CompileWatch in tests — one decode compile
+  across a multi-request trace). Idle/prefilling slots ride along at
+  position -1: their q-rows compute masked garbage that is discarded and
+  their K/V writes resolve to the sentinel block and drop.
+- ``prefill chunk``: `prefill_chunk` tokens of ONE slot's prompt,
+  interleaved one chunk per engine iteration so a long prompt never
+  stalls the in-flight decode batch. The final (padded) chunk returns
+  the last valid position's logits — the request's first token (TTFT).
+
+Both run `generate._decode_layers` against `PagedKVCache` — the same
+layer math as the offline contiguous path, which is what makes greedy
+token parity between the two cache implementations a pinned test
+invariant. tp-sharded params from `generate.place_for_decode` work
+unchanged: the programs are pure GSPMD, XLA propagates the shardings
+through the block pool and inserts the collectives.
+
+Sampling keys derive from (request id, token index), so tokens are
+independent of slot assignment, arrival interleaving, and preemption —
+the ragged-batch-invariance property the tests pin.
+
+Observability rides the existing telemetry machinery: the GoodputLedger
+books queue_wait / prefill / decode (compile time drained out exactly
+via CompileWatch), per-request TTFT and per-token latency land in the
+registry histograms and as ``serve_request`` / ``serve_summary`` JSONL
+events, and tools/telemetry_report.py renders the serving view
+(p50/p95 TTFT, tok/s, slot occupancy, pool utilization).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from picotron_tpu.config import ModelConfig, ServeConfig
+from picotron_tpu.generate import _decode_layers, _logits_last
+from picotron_tpu.models.llama import (
+    compute_dtype, final_hidden, head_weight, model_rope_tables,
+)
+from picotron_tpu.serve.paged_cache import (
+    BlockPool, PagedKVCache, init_paged_cache,
+)
+from picotron_tpu.serve.scheduler import Request, Scheduler, blocks_for
+from picotron_tpu.telemetry import Telemetry
+
+
+# ---------------------------------------------------------------------------
+# Device programs (module-level so every engine shares one jit cache)
+# ---------------------------------------------------------------------------
+
+
+def _fold_keys(base_key, rids, tidx):
+    """[S] sampling keys from (request id, token index) — slot/order
+    independent, so continuous batching and preemption replay cannot
+    perturb sampled tokens."""
+    return jax.vmap(
+        lambda r, t: jax.random.fold_in(jax.random.fold_in(base_key, r), t)
+    )(rids, tidx)
+
+
+def _decode_step_impl(params, k, v, tables, toks, positions, rids, tidx,
+                      base_key, cos, sin, cfg: ModelConfig,
+                      temperature: float, top_k: int, interval: int,
+                      eos_token_id):
+    """`interval` decode steps over all slots inside ONE dispatch (a
+    lax.scan — amortizes per-dispatch host overhead over interval tokens
+    per slot; the same reason offline generate scans its whole decode).
+    toks/positions/rids/tidx: [S]; positions < 0 = idle slot (output
+    ignored, write dropped). Slots that emit EOS mid-interval are forced
+    to keep emitting EOS — identical semantics to generate.py's scan —
+    and the host truncates + retires them at dispatch end. Returns
+    (tokens [S, interval], next positions, next tidx, k, v); the
+    position/index outputs feed the steady-state fast path straight back
+    in, so an unchanged slot roster costs zero host->device uploads
+    (measured ~2x the whole dispatch on the CPU tiny-model bench)."""
+    live = positions >= 0
+
+    def one(carry, _):
+        toks, positions, tidx, cache, done = carry
+        x = params["embedding"][toks[:, None]].astype(compute_dtype(cfg))
+        x, cache = _decode_layers(params, x, cache, positions[:, None],
+                                  cfg, cos, sin)
+        logits = _logits_last(params, x, cfg)  # [S, V] fp32
+        if temperature == 0.0:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            lg = logits / temperature
+            if top_k > 0:
+                kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+                lg = jnp.where(lg < kth, -jnp.inf, lg)
+            keys = _fold_keys(base_key, rids, tidx)
+            nxt = jax.vmap(
+                lambda l, key: jax.random.categorical(key, l)
+            )(lg, keys).astype(jnp.int32)
+        if eos_token_id is not None:
+            nxt = jnp.where(done, eos_token_id, nxt)
+            done = done | (nxt == eos_token_id)
+        positions = jnp.where(live, positions + 1, positions)
+        tidx = jnp.where(live, tidx + 1, tidx)
+        return (nxt, positions, tidx, cache, done), nxt
+
+    cache = PagedKVCache(k, v, tables)
+    done = jnp.zeros(toks.shape, bool)
+    (last, positions, tidx, cache, _), toks_all = jax.lax.scan(
+        one, (toks, positions, tidx, cache, done), None, length=interval)
+    return toks_all.T, last, positions, tidx, cache.k, cache.v
+
+
+def _prefill_chunk_impl(params, k, v, table_rows, chunk_ids, start_pos,
+                        n_valid, rids, tidx, base_key, cos, sin,
+                        cfg: ModelConfig, temperature: float, top_k: int):
+    """Prefill the next chunk of EVERY mid-prefill slot in one dispatch:
+    chunk_ids [S, C] (padded), start_pos/n_valid/rids/tidx [S],
+    table_rows [S, max_blocks]. Rows with n_valid = 0 are idle slots
+    riding along (all positions -1: writes sentinel-drop, outputs
+    discarded); padded positions inside a live row behave the same.
+    Batching matters: a per-slot prefill dispatch measured ~2x the
+    static sampler's batched prompt pass on the CPU bench — one [S, C]
+    program closes that. Samples each row's next token off its last
+    valid position's logits with the same (request id, token index) key
+    derivation as the decode step — one sampling law everywhere.
+    Returns (k, v, tokens [S])."""
+    s, c = chunk_ids.shape
+    t = jnp.arange(c)[None, :]
+    pos = jnp.where(t < n_valid[:, None], start_pos[:, None] + t, -1)
+    cache = PagedKVCache(k, v, table_rows)
+    x = params["embedding"][chunk_ids].astype(compute_dtype(cfg))
+    x, cache = _decode_layers(params, x, cache, pos, cfg, cos, sin)
+    last = jnp.maximum(n_valid - 1, 0)  # [S]
+    h_last = jnp.take_along_axis(x, last[:, None, None], axis=1)  # [S,1,H]
+    hf = final_hidden(params, h_last, cfg)
+    logits = (hf @ head_weight(params).astype(hf.dtype))[:, 0]
+    logits = logits.astype(jnp.float32)  # [S, V]
+    if temperature == 0.0:
+        toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        lg = logits / temperature
+        if top_k > 0:
+            kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+        keys = _fold_keys(base_key, rids, tidx)
+        toks = jax.vmap(
+            lambda l, key: jax.random.categorical(key, l)
+        )(lg, keys).astype(jnp.int32)
+    return cache.k, cache.v, toks
+
+
+_JITS: dict = {}
+
+
+def _get_jits(donate: bool):
+    """Jitted (decode, prefill) pair, shared across engines so repeated
+    engine construction (tests, bench baseline+serve in one process)
+    reuses the compile cache. Cache donation is only requested off-CPU —
+    the CPU backend ignores donation with a warning per call site."""
+    if donate not in _JITS:
+        dargs = (1, 2) if donate else ()
+        _JITS[donate] = (
+            jax.jit(_decode_step_impl, donate_argnums=dargs,
+                    static_argnames=("cfg", "temperature", "top_k",
+                                     "interval", "eos_token_id")),
+            jax.jit(_prefill_chunk_impl, donate_argnums=dargs,
+                    static_argnames=("cfg", "temperature", "top_k")),
+        )
+    return _JITS[donate]
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+
+class ServeEngine:
+    def __init__(self, params, model_cfg: ModelConfig,
+                 serve_cfg: Optional[ServeConfig] = None, *,
+                 eos_token_id: Optional[int] = None,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 telemetry: Optional[Telemetry] = None):
+        scfg = serve_cfg or ServeConfig()
+        scfg.validate()
+        self.params = params
+        self.cfg = model_cfg
+        self.scfg = scfg
+        self.eos_token_id = eos_token_id
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.base_key = jax.random.key(seed)
+
+        self.max_len = scfg.max_model_len or model_cfg.max_position_embeddings
+        self.block_size = scfg.block_size
+        self.max_blocks = blocks_for(self.max_len, self.block_size)
+        self.num_blocks = (scfg.num_blocks
+                           or scfg.decode_slots * self.max_blocks)
+        self.num_slots = scfg.decode_slots
+
+        self.cos, self.sin = model_rope_tables(model_cfg,
+                                               max_len=self.max_len)
+        cache = init_paged_cache(model_cfg, self.num_blocks,
+                                 self.block_size, self.num_slots,
+                                 self.max_blocks)
+        self._k, self._v = cache.k, cache.v
+
+        # Sharding discipline: every decode/prefill input keeps ONE
+        # explicit sharding for the engine's whole lifetime. Committed
+        # and uncommitted arrays key DIFFERENT jit variants, and
+        # commitment spreads through outputs — one committed argument
+        # (e.g. place_for_decode'd params) cascades into k/v and then
+        # every upload, minting fresh 0.6 s recompiles mid-trace (caught
+        # on the CPU bench). Committing everything up front collapses the
+        # variant space to exactly one per program. With tp > 1 the KV
+        # pool is pinned over the kv-head axis — the layout GSPMD picks
+        # for TP attention.
+        from jax.sharding import NamedSharding, PartitionSpec
+        self._rep_sh = None
+        for leaf in jax.tree.leaves(params):
+            sh = getattr(leaf, "sharding", None)
+            if isinstance(sh, NamedSharding):
+                mesh = sh.mesh
+                self._rep_sh = NamedSharding(mesh, PartitionSpec())
+                kv_sh = NamedSharding(
+                    mesh,
+                    PartitionSpec(None, None, None, "tp", None)
+                    if dict(zip(mesh.axis_names,
+                                mesh.devices.shape)).get("tp", 1) > 1
+                    else PartitionSpec())
+                break
+        if self._rep_sh is None:
+            dev = jax.devices()[0]
+            self._rep_sh = jax.sharding.SingleDeviceSharding(dev)
+            kv_sh = self._rep_sh
+        self._k = jax.device_put(self._k, kv_sh)
+        self._v = jax.device_put(self._v, kv_sh)
+        self.cos = jax.device_put(self.cos, self._rep_sh)
+        self.sin = jax.device_put(self.sin, self._rep_sh)
+        self.base_key = jax.device_put(self.base_key, self._rep_sh)
+        # host mirror of the device block tables; sentinel = num_blocks
+        self._tables = np.full((self.num_slots, self.max_blocks),
+                               self.num_blocks, np.int32)
+        self.pool = BlockPool(self.num_blocks)
+        self.sched = Scheduler(self.num_slots, self.pool, self.block_size,
+                               self.max_blocks)
+
+        self._owns_telemetry = telemetry is None
+        self.telemetry = telemetry or Telemetry(sinks=[])
+        self._decode_jit, self._prefill_jit = _get_jits(
+            jax.default_backend() != "cpu")
+
+        self._t0 = time.perf_counter()  # trace clock zero (run() resets)
+        # steady-state decode fast path: device-resident step inputs,
+        # valid while the slot roster and block tables are unchanged
+        self._decode_state: Optional[dict] = None
+        self.results: list = []
+        self.stats = {
+            "decode_steps": 0, "decode_compiles": 0,
+            "prefill_chunks": 0, "occupancy_sum": 0.0,
+            "output_tokens": 0, "prefill_tokens": 0,
+        }
+        self._next_auto_id = 0
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int,
+               req_id: Optional[int] = None, arrival: float = 0.0) -> int:
+        if req_id is None:
+            req_id = self._next_auto_id
+        self._next_auto_id = max(self._next_auto_id, req_id + 1)
+        self.sched.submit(Request(req_id, tuple(prompt), max_new_tokens,
+                                  arrival))
+        return req_id
+
+    # -- helpers -----------------------------------------------------------
+
+    def _sync_table(self, slot: int) -> None:
+        st = self.sched.slots[slot]
+        row = np.full((self.max_blocks,), self.num_blocks, np.int32)
+        if st is not None and st.blocks:
+            row[:len(st.blocks)] = st.blocks
+        self._tables[slot] = row
+        self._decode_state = None  # roster/table changed: slow path next
+
+    def _drain_compile(self) -> float:
+        n, secs = self.telemetry.compile_watch.drain()
+        if n:
+            self.telemetry.emit("compile", category="compile", secs=secs,
+                                compiles=n)
+        return secs if n else 0.0
+
+    def _emit_retired(self, st, now: float) -> dict:
+        req = st.req
+        ttft = (st.t_first_token - req.arrival
+                if st.t_first_token is not None else None)
+        res = {
+            "id": req.id,
+            "prompt_len": len(req.prompt),
+            "tokens": list(st.generated),
+            "output_tokens": len(st.generated),
+            "queue_wait_s": max((st.t_admit or 0.0) - req.arrival, 0.0),
+            "ttft_s": ttft,
+            "latency_s": max(now - req.arrival, 0.0),
+            "n_preempted": st.n_preempted,
+        }
+        self.results.append(res)
+        self.telemetry.emit(
+            "serve_request",
+            id=req.id, prompt_tokens=res["prompt_len"],
+            output_tokens=res["output_tokens"],
+            queue_wait_s=round(res["queue_wait_s"], 6),
+            ttft_s=round(ttft, 6) if ttft is not None else None,
+            latency_s=round(res["latency_s"], 6),
+            preempted=st.n_preempted)
+        return res
+
+    # -- one engine iteration ---------------------------------------------
+
+    def step(self, now: Optional[float] = None) -> bool:
+        """Admit; run ONE prefill chunk (if any prompt is mid-prefill);
+        run ONE decode step over the slot batch; retire. Returns whether
+        any device work ran."""
+        if now is None:
+            now = time.perf_counter() - self._t0
+        reg = self.telemetry.registry
+
+        for slot, st in self.sched.admit(now):
+            self._sync_table(slot)
+            wait = max(now - st.req.arrival, 0.0)
+            # "phase" events carry (category, secs) so a post-hoc sum of
+            # the JSONL reproduces the in-process ledger, exactly like the
+            # training stream's phase events
+            self.telemetry.emit("phase", phase="queue_wait",
+                                category="queue_wait", secs=wait,
+                                id=st.req.id)
+            reg.histogram("serve/queue_wait").observe(wait)
+
+        worked = False
+
+        # ---- one prefill chunk per mid-prefill slot, batched into a
+        # single dispatch and interleaved with the decode step
+        pslots = self.sched.prefill_slots()
+        if pslots:
+            c = self.scfg.prefill_chunk
+            ids = np.zeros((self.num_slots, c), np.int32)
+            start = np.zeros((self.num_slots,), np.int32)
+            nval = np.zeros((self.num_slots,), np.int32)
+            rids = np.zeros((self.num_slots,), np.int32)
+            tidx = np.zeros((self.num_slots,), np.int32)
+            finals = []
+            for s in pslots:
+                st = self.sched.slots[s]
+                chunk = st.prefill_ids[st.n_prefilled:st.n_prefilled + c]
+                ids[s, :len(chunk)] = chunk
+                start[s] = st.n_prefilled
+                nval[s] = len(chunk)
+                rids[s] = st.req.id
+                tidx[s] = len(st.generated)
+                if st.n_prefilled + len(chunk) >= len(st.prefill_ids):
+                    finals.append(s)
+            up = partial(jax.device_put, device=self._rep_sh)
+            self._drain_compile()
+            t0 = time.perf_counter()
+            self._k, self._v, toks_d = self._prefill_jit(
+                self.params, self._k, self._v, up(self._tables), up(ids),
+                up(start), up(nval), up(rids), up(tidx), self.base_key,
+                self.cos, self.sin, cfg=self.cfg,
+                temperature=self.temperature, top_k=self.top_k)
+            toks = np.asarray(toks_d) if finals else None
+            dt = time.perf_counter() - t0
+            dt -= min(self._drain_compile(), dt)
+            n_prefilled = int(nval.sum())
+            self.telemetry.emit("phase", phase="prefill",
+                                category="prefill", secs=dt,
+                                tokens=n_prefilled)
+            for s in pslots:
+                self.sched.note_prefilled(s, int(nval[s]))
+            self.stats["prefill_chunks"] += len(pslots)
+            self.stats["prefill_tokens"] += n_prefilled
+            for s in finals:
+                st = self.sched.slots[s]
+                st.generated.append(int(toks[s]))
+                self.stats["output_tokens"] += 1
+                if st.t_first_token is None:
+                    st.t_first_token = now + dt
+                    ttft = max(st.t_first_token - st.req.arrival, 0.0)
+                    reg.histogram("serve/ttft").observe(ttft)
+                if self.sched.should_retire(s, self.eos_token_id):
+                    st = self.sched.retire(s)
+                    self._sync_table(s)
+                    self._emit_retired(st, now + dt)
+            worked = True
+
+        # ---- one decode step over every slot with a live sequence
+        ready = self.sched.decode_ready()
+        if ready:
+            active = []
+            dropped: set = set()
+            interval = self.scfg.decode_interval
+            for s in ready:
+                if s in dropped:
+                    continue
+                st = self.sched.slots[s]
+                horizon = min(interval,
+                              st.req.max_new_tokens - len(st.generated))
+                n_before = len(st.blocks)
+                ok, preempted = self.sched.ensure_block(s, horizon)
+                dropped.update(preempted)
+                for p in preempted:
+                    self._sync_table(p)
+                if ok:
+                    if len(self.sched.slots[s].blocks) != n_before:
+                        self._sync_table(s)
+                    active.append(s)
+            # a later ensure_block can preempt a slot already activated
+            # (it was younger than the one needing the block)
+            active = [s for s in active if s not in dropped]
+            if active:
+                ds = self._decode_state
+                if ds is None or ds["active"] != active:
+                    # slow path: roster changed — rebuild inputs on host,
+                    # uploaded with the shardings earlier calls produced
+                    # so the rebuild cannot mint a new jit variant
+                    toks = np.zeros((self.num_slots,), np.int32)
+                    positions = np.full((self.num_slots,), -1, np.int32)
+                    rids = np.zeros((self.num_slots,), np.int32)
+                    tidx = np.zeros((self.num_slots,), np.int32)
+                    for s in active:
+                        st = self.sched.slots[s]
+                        toks[s] = st.last_token
+                        positions[s] = st.write_pos
+                        rids[s] = st.req.id
+                        tidx[s] = len(st.generated)
+                    up = partial(jax.device_put, device=self._rep_sh)
+                    ds = {"active": list(active),
+                          "tables": up(self._tables),
+                          "toks": up(toks),
+                          "positions": up(positions),
+                          "rids": up(rids),
+                          "tidx": up(tidx)}
+                self._drain_compile()
+                t0 = time.perf_counter()
+                toks_d, last_d, pos_d, tidx_d, self._k, self._v = \
+                    self._decode_jit(
+                        self.params, self._k, self._v,
+                        ds["tables"], ds["toks"], ds["positions"],
+                        ds["rids"], ds["tidx"], self.base_key, self.cos,
+                        self.sin, cfg=self.cfg,
+                        temperature=self.temperature, top_k=self.top_k,
+                        interval=interval,
+                        eos_token_id=self.eos_token_id)
+                nxt = np.asarray(toks_d)  # [S, interval]
+                # feed outputs forward; any roster/table change below
+                # nulls this via _sync_table
+                self._decode_state = dict(ds, toks=last_d, positions=pos_d,
+                                          tidx=tidx_d)
+                dt = time.perf_counter() - t0
+                csecs = self._drain_compile()
+                if csecs:
+                    self.stats["decode_compiles"] += 1
+                dt -= min(csecs, dt)
+                n_tokens = 0
+                for s in active:
+                    st = self.sched.slots[s]
+                    for t in range(interval):
+                        st.generated.append(int(nxt[s, t]))
+                        n_tokens += 1
+                        if self.sched.should_retire(s, self.eos_token_id):
+                            # interval tokens past EOS/budget are padding
+                            st = self.sched.retire(s)
+                            self._sync_table(s)
+                            self._emit_retired(st, now + dt)
+                            break
+                self.telemetry.emit("phase", phase="decode",
+                                    category="decode", secs=dt,
+                                    tokens=n_tokens)
+                reg.histogram("serve/token_latency").observe(
+                    dt / max(len(active) * interval, 1))
+                self.stats["decode_steps"] += 1
+                self.stats["occupancy_sum"] += len(active) / self.num_slots
+                self.stats["output_tokens"] += n_tokens
+                reg.gauge("serve/slot_occupancy").set(
+                    len(active) / self.num_slots)
+                reg.gauge("serve/pool_utilization").set(
+                    self.pool.in_use / self.num_blocks)
+                worked = True
+        return worked
+
+    # -- trace driver ------------------------------------------------------
+
+    def run(self, requests=()) -> list:
+        """Drive a whole trace: submit each (prompt, max_new_tokens[,
+        arrival]) when its arrival time passes on the trace clock, loop
+        engine steps until queue and slots drain. Returns per-request
+        result dicts sorted by request id."""
+        pending = sorted(requests, key=lambda r: r[2] if len(r) > 2 else 0.0)
+        self._t0 = t0 = time.perf_counter()
+        while pending or self.sched.has_work():
+            now = time.perf_counter() - t0
+            while pending and (pending[0][2] if len(pending[0]) > 2
+                               else 0.0) <= now:
+                r = pending.pop(0)
+                self.submit(r[0], r[1],
+                            arrival=r[2] if len(r) > 2 else 0.0)
+            if not self.sched.has_work():
+                time.sleep(min(max(pending[0][2] - now, 0.0), 0.01))
+                continue
+            self.step(now)
+        self._emit_summary(time.perf_counter() - t0)
+        return sorted(self.results, key=lambda r: r["id"])
+
+    def _emit_summary(self, wall: float) -> None:
+        reg = self.telemetry.registry
+        ttft = reg.histogram("serve/ttft")
+        lat = reg.histogram("serve/token_latency")
+        qw = reg.histogram("serve/queue_wait")
+        steps = max(self.stats["decode_steps"], 1)
+        self.summary = {
+            "requests": len(self.results),
+            "output_tokens": sum(r["output_tokens"] for r in self.results),
+            "wall_s": round(wall, 6),
+            "tokens_per_sec": round(
+                sum(r["output_tokens"] for r in self.results)
+                / max(wall, 1e-9), 2),
+            "ttft_p50_s": ttft.p50, "ttft_p95_s": ttft.p95,
+            "token_latency_p50_s": lat.p50, "token_latency_p95_s": lat.p95,
+            "queue_wait_p50_s": qw.p50, "queue_wait_p95_s": qw.p95,
+            "slot_occupancy": round(self.stats["occupancy_sum"] / steps, 4),
+            "pool_peak_utilization": round(
+                self.pool.peak_in_use / self.num_blocks, 4),
+            "decode_steps": self.stats["decode_steps"],
+            "decode_compiles": self.stats["decode_compiles"],
+            "prefill_chunks": self.stats["prefill_chunks"],
+            "preemptions": self.sched.n_preempted,
+            "slots": self.num_slots,
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+        }
+        self.telemetry.emit("serve_summary", **self.summary)
+
+    def close(self) -> None:
+        if self._owns_telemetry:
+            self.telemetry.close()

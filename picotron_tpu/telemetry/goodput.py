@@ -21,6 +21,10 @@ Every timed second of the run is booked to exactly one category:
 - ``host_sync``    — device->host metric fetch for guards/logging.
 - ``eval``         — validation passes.
 - ``other``        — anything booked without a better class.
+- ``prefill`` / ``decode`` / ``queue_wait`` — serving streams only
+                     (picotron_tpu/serve): the engine's two jitted
+                     programs (both goodput — tokens leaving the system)
+                     and time requests sat queued before admission.
 
 The per-phase -> category mapping is shared with tools/telemetry_report.py
 (PHASE_CATEGORY) so in-process booking and post-hoc JSONL analysis can
@@ -32,7 +36,12 @@ watchdog/stall events — the ledger only books what it observed end-to-end.
 
 from __future__ import annotations
 
-GOODPUT_CATEGORIES = ("compute",)
+# Training streams book "compute" only; serving streams (picotron_tpu/
+# serve) book "prefill" and "decode" — both are the serving engine's
+# productive device work. The two kinds of stream never book each
+# other's categories, so adding the serving pair leaves every training
+# report's goodput % untouched.
+GOODPUT_CATEGORIES = ("compute", "prefill", "decode")
 
 # Step-loop phase name -> ledger category. "step" is special-cased in
 # book_phase (compute vs replay vs compile split); everything else maps
@@ -51,6 +60,9 @@ PHASE_CATEGORY = {
 CATEGORIES = (
     "compute", "compile", "replay", "restore", "ckpt_io", "preempt",
     "retry_backoff", "data_wait", "host_sync", "eval", "other",
+    # serving (picotron_tpu/serve): device time in the two jitted
+    # programs (goodput) and the admission-latency badput
+    "prefill", "decode", "queue_wait",
 )
 
 

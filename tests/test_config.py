@@ -100,3 +100,44 @@ def test_ce_chunk_and_lr_ratio_validation():
         Config(training=TrainingConfig(ce_chunk_size=512)).validate()
     with pytest.raises(ValueError, match="lr_min_ratio"):
         Config(training=TrainingConfig(lr_min_ratio=-0.1)).validate()
+
+
+def test_serve_config_validation():
+    import pytest
+
+    from picotron_tpu.config import Config, ModelConfig, ServeConfig
+
+    Config().validate()  # defaults carry a valid serve block
+    for bad in (dict(decode_slots=0), dict(block_size=0),
+                dict(prefill_chunk=0), dict(decode_interval=0),
+                dict(num_blocks=-1), dict(max_model_len=-1)):
+        with pytest.raises(ValueError, match="serve"):
+            Config(serve=ServeConfig(**bad)).validate()
+    # per-sequence serving capacity cannot exceed the model's positions
+    from picotron_tpu.config import TrainingConfig
+
+    small = TrainingConfig(seq_length=64)
+    with pytest.raises(ValueError, match="max_model_len"):
+        Config(model=ModelConfig(max_position_embeddings=128),
+               training=small,
+               serve=ServeConfig(max_model_len=256)).validate()
+    Config(model=ModelConfig(max_position_embeddings=256),
+           training=small,
+           serve=ServeConfig(max_model_len=256)).validate()
+
+
+def test_serve_config_from_dict_round_trip():
+    from picotron_tpu.config import config_from_dict
+
+    cfg = config_from_dict({
+        "model": {"name": "debug-tiny"},
+        "serve": {"decode_slots": 4, "block_size": 8, "num_blocks": 16,
+                  "prefill_chunk": 32, "max_model_len": 256,
+                  "decode_interval": 2},
+    })
+    assert cfg.serve.decode_slots == 4 and cfg.serve.num_blocks == 16
+    assert cfg.serve.decode_interval == 2
+    # unknown keys in the section are ignored (reference-JSON compat)
+    cfg2 = config_from_dict({"model": {"name": "debug-tiny"},
+                             "serve": {"decode_slots": 2, "bogus": 1}})
+    assert cfg2.serve.decode_slots == 2

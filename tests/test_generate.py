@@ -100,6 +100,34 @@ def test_sampling_shapes_and_determinism(tiny):
     assert not np.array_equal(np.asarray(a), np.asarray(c))
 
 
+def test_eos_early_exit_token_parity(tiny):
+    """With eos_token_id the decode loop is a while_loop that stops once
+    every row has emitted EOS, instead of burning max_new_tokens steps.
+    Token parity with the non-early-exit path: run WITHOUT eos (the
+    fixed-trip scan), post-pad everything after each row's first EOS,
+    and the early-exit output must be identical."""
+    cfg, params = tiny
+    prompt = jax.random.randint(jax.random.key(3), (3, 5), 0,
+                                cfg.vocab_size)
+    free = np.asarray(generate(params, cfg, prompt, 10))  # scan path
+    eos = int(free[0, 5 + 1])  # row 0's second generated token
+    want = free.copy()
+    for row in want:
+        gen = row[5:]
+        hits = np.where(gen == eos)[0]
+        if hits.size:
+            gen[hits[0]:] = eos
+    got = np.asarray(generate(params, cfg, prompt, 10, eos_token_id=eos))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_eos_early_exit_single_token(tiny):
+    cfg, params = tiny
+    prompt = jnp.zeros((2, 3), jnp.int32)
+    out = generate(params, cfg, prompt, 1, eos_token_id=0)
+    assert out.shape == (2, 4)
+
+
 def test_eos_padding(tiny):
     cfg, params = tiny
     prompt = jax.random.randint(jax.random.key(2), (2, 4), 0, cfg.vocab_size)

@@ -99,6 +99,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--subset", default=None)
     p.add_argument("--split", default="train")
     p.add_argument("--tokenizer", default=None)
+    # serving (picotron_tpu/serve: continuous batching + paged KV cache)
+    p.add_argument("--serve-slots", type=int, default=None,
+                   help="serving decode batch width (writes the `serve` "
+                        "config block; picotron_tpu/serve)")
+    p.add_argument("--serve-block-size", type=int, default=None,
+                   help="tokens per paged-KV-cache block")
+    p.add_argument("--serve-num-blocks", type=int, default=None,
+                   help="physical blocks in the shared KV pool (0 = "
+                        "worst-case auto; set lower to oversubscribe — "
+                        "the scheduler preempts youngest-first)")
+    p.add_argument("--serve-prefill-chunk", type=int, default=None,
+                   help="prompt tokens prefilled per engine iteration")
+    p.add_argument("--serve-max-len", type=int, default=None,
+                   help="per-sequence serving capacity (0 = the model's "
+                        "max_position_embeddings)")
+    p.add_argument("--serve-decode-interval", type=int, default=None,
+                   help="decode steps scanned per dispatch (amortizes "
+                        "host overhead; retirement latency quantizes "
+                        "to it)")
     # checkpoint / logging
     p.add_argument("--save-frequency", type=int, default=0)
     p.add_argument("--auto-resume", action="store_true",
@@ -185,6 +204,16 @@ def create_single_config(args) -> str:
                            args.out_dir, args.exp_name, "ckpt"))},
         "logging": {"use_wandb": args.use_wandb, "run_name": args.exp_name},
     }
+    serve = {k: v for k, v in dict(
+        decode_slots=args.serve_slots,
+        block_size=args.serve_block_size,
+        num_blocks=args.serve_num_blocks,
+        prefill_chunk=args.serve_prefill_chunk,
+        max_model_len=args.serve_max_len,
+        decode_interval=args.serve_decode_interval,
+    ).items() if v is not None}
+    if serve:
+        raw["serve"] = serve
     if getattr(args, "download_model", False):
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         from download_model import download

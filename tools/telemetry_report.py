@@ -82,6 +82,8 @@ def summarize(events: list[dict]) -> dict:
     replayed = 0
     step_rows: list[dict] = []
     eval_rows: list[dict] = []
+    serve_reqs: list[dict] = []
+    serve_summary: dict | None = None
     ts = [e["ts"] for e in events if isinstance(e.get("ts"), (int, float))]
 
     for e in events:
@@ -107,6 +109,10 @@ def summarize(events: list[dict]) -> dict:
         elif kind == "bench_step" and isinstance(secs, (int, float)):
             # bench.py --telemetry streams: per-step samples, no phases
             phases.setdefault("bench_step", []).append(secs)
+        elif kind == "serve_request":
+            serve_reqs.append(e)
+        elif kind == "serve_summary":
+            serve_summary = e  # last wins (one per engine run)
 
     accounted = sum(categories.values())
     goodput = sum(categories.get(c, 0.0) for c in GOODPUT_CATEGORIES)
@@ -154,7 +160,49 @@ def summarize(events: list[dict]) -> dict:
     if eval_rows:
         out["training"] = out.get("training", {})
         out["training"]["final_val_loss"] = eval_rows[-1].get("val_loss")
+    if serve_reqs or serve_summary:
+        out["serving"] = serving_view(serve_reqs, serve_summary)
     return out
+
+
+def serving_view(reqs: list[dict], summary: dict | None) -> dict:
+    """SLO view of a serving stream: per-request TTFT/queue-wait
+    percentiles recomputed from the serve_request events (so the view
+    works even on a stream truncated before its serve_summary), plus the
+    engine-level aggregates (tok/s, per-token latency, slot occupancy,
+    pool utilization) from the serve_summary when present."""
+    view: dict = {"requests": len(reqs)}
+    ttfts = [r["ttft_s"] for r in reqs
+             if isinstance(r.get("ttft_s"), (int, float))]
+    waits = [r["queue_wait_s"] for r in reqs
+             if isinstance(r.get("queue_wait_s"), (int, float))]
+    toks = [r["output_tokens"] for r in reqs
+            if isinstance(r.get("output_tokens"), (int, float))]
+    if ttfts:
+        view["ttft_p50_ms"] = round(_pctile(ttfts, 50) * 1e3, 2)
+        view["ttft_p95_ms"] = round(_pctile(ttfts, 95) * 1e3, 2)
+    if waits:
+        view["queue_wait_p50_ms"] = round(_pctile(waits, 50) * 1e3, 2)
+        view["queue_wait_p95_ms"] = round(_pctile(waits, 95) * 1e3, 2)
+    if toks:
+        view["output_tokens"] = int(sum(toks))
+    if summary:
+        for src, dst, scale in (
+                ("tokens_per_sec", "tokens_per_sec", 1),
+                ("token_latency_p50_s", "token_latency_p50_ms", 1e3),
+                ("token_latency_p95_s", "token_latency_p95_ms", 1e3),
+                ("slot_occupancy", "slot_occupancy", 1),
+                ("pool_peak_utilization", "pool_peak_utilization", 1),
+                ("decode_steps", "decode_steps", 1),
+                ("decode_compiles", "decode_compiles", 1),
+                ("preemptions", "preemptions", 1),
+                ("wall_s", "wall_s", 1)):
+            val = summary.get(src)
+            if isinstance(val, (int, float)):
+                view[dst] = round(val * scale, 4)
+        view.setdefault("requests", summary.get("requests"))
+        view.setdefault("output_tokens", summary.get("output_tokens"))
+    return view
 
 
 def comm_row(events: list[dict], config_path: str,
@@ -238,6 +286,26 @@ def render(s: dict, markdown: bool = False) -> str:
                f"measured sync p50 {cm['measured_sync_p50_ms']} ms"
                + (f" | drift {drift:+.1f}%" if drift is not None else ""))
         lines.append(f"**{msg}**" if markdown else msg)
+        lines.append("")
+    sv = s.get("serving")
+    if sv:
+        hdr = "### Serving" if markdown else "serving:"
+        lines.append(hdr)
+        pair = lambda k: (f"{sv[k]}" if k in sv else "n/a")  # noqa: E731
+        lines.append(
+            f"  {sv.get('requests', 0)} requests, "
+            f"{sv.get('output_tokens', 0)} output tokens @ "
+            f"{pair('tokens_per_sec')} tok/s | "
+            f"TTFT p50 {pair('ttft_p50_ms')} ms p95 {pair('ttft_p95_ms')} "
+            f"ms | token latency p50 {pair('token_latency_p50_ms')} ms "
+            f"p95 {pair('token_latency_p95_ms')} ms")
+        lines.append(
+            f"  queue wait p50 {pair('queue_wait_p50_ms')} ms p95 "
+            f"{pair('queue_wait_p95_ms')} ms | slot occupancy "
+            f"{pair('slot_occupancy')} | pool peak util "
+            f"{pair('pool_peak_utilization')} | decode steps "
+            f"{pair('decode_steps')} (compiles {pair('decode_compiles')}) "
+            f"| preemptions {pair('preemptions')}")
         lines.append("")
     ev = ", ".join(f"{k}={v}" for k, v in s["events"].items())
     lines.append(f"events: {ev}" if not markdown else f"**events:** {ev}")
