@@ -16,6 +16,10 @@ from picotron_tpu.analysis.cost_model import (  # noqa: F401
     Calibration, CostModel, GENERATIONS, StepCost, resolve_generation,
     spearman,
 )
+from picotron_tpu.analysis.dataflow import (  # noqa: F401
+    BoundaryReshard, CollectiveSite, attribute_collectives, audit_dataflow,
+    collect_sites, predict_boundary_reshards,
+)
 from picotron_tpu.analysis.hazards import (  # noqa: F401
     check_donation, check_state_stability, parse_arg_donation,
 )
@@ -32,3 +36,7 @@ from picotron_tpu.analysis.spec_lint import (  # noqa: F401
     lint_param_specs, lint_specs,
 )
 from picotron_tpu.analysis.trace import lower_train_step  # noqa: F401
+from picotron_tpu.analysis.variants import (  # noqa: F401
+    AbstractSig, audit_feeds, audit_variants, check_engine_feed,
+    prove_serve_programs, prove_train_step, signature_of,
+)

@@ -123,8 +123,14 @@ def parse_collectives(text: str) -> list[CollectiveOp]:
     for i, line in enumerate(lines):
         kind = None
         for k in KINDS:
+            # compiled HLO spells the opcode hyphenated at its call site
+            # (`%ag = f32[8,8]{1,0} all-gather(...)`, async `-start`
+            # variant included; `-done` and operand references are not
+            # new ops)
             if (f"stablehlo.{k}" in line
-                    or re.search(rf"=\s+{k.replace('_', '-')}", line)):
+                    or re.search(
+                        rf"(?<![%a-z-]){k.replace('_', '-')}(?:-start)?\(",
+                        line)):
                 kind = k
                 break
         if kind is None:

@@ -368,6 +368,21 @@ class CostModel:
                            "secs": secs, "axis_guess": guess})
         return priced
 
+    def price_reshards(self, cfg: Config, reshards) -> tuple:
+        """(secs, bytes) for predicted boundary reshards
+        (analysis/dataflow.py BoundaryReshard). GSPMD materializes a spec
+        mismatch as an all-gather of the full logical tensor; the static
+        prediction cannot know which axis the partitioner routes it over,
+        so budget the slowest placed axis — the conservative bound the
+        planner should price unintended traffic at."""
+        links = [l for l in self.axes_for(cfg).values() if l.size > 1]
+        worst = min(links, key=lambda l: l.bandwidth, default=None)
+        if worst is None:
+            return 0.0, sum(r.nbytes for r in reshards)
+        secs = sum(self.collective_secs("all_gather", r.nbytes, worst)
+                   for r in reshards)
+        return secs, sum(r.nbytes for r in reshards)
+
     @staticmethod
     def _match_axes(op, sizes: dict) -> tuple:
         """Mesh axes a parsed op most plausibly spans."""

@@ -1,0 +1,561 @@
+"""Sharding-dataflow audit — WHY each collective exists, not just whether.
+
+The collective-schedule audit (analysis/collectives.py) reads the lowered
+module and checks the schedule a layout promises; it cannot say which
+source op minted an op, nor spot a collective the *compiler* invented. Both
+gaps matter under GSPMD: wherever two PartitionSpecs disagree, the
+partitioner silently inserts resharding collectives — the all-gather-
+minting smell — and the only runtime symptom is a slower step. This module
+closes the loop at trace time:
+
+- **Provenance** (`collect_sites`): walk the traced step's jaxpr —
+  recursively through pjit/scan/shard_map/remat/custom-vjp sub-jaxprs —
+  and record every collective primitive as a `CollectiveSite`: normalized
+  kind, mesh axes, the `picotron_tpu/file:line` that issued it (from the
+  equation's traceback), and the root state/batch pytree paths whose data
+  feeds it (def-use propagation from the jaxpr's invars).
+- **Attribution** (`attribute_collectives`): match the lowered module's
+  parsed `CollectiveOp`s back to sites by kind + expected replica-group
+  size (the product of the site's mesh-axis sizes). A lowered collective
+  no site explains is *implicit* — GSPMD-minted, not authored.
+- **Classification** (`intended_rule`): a site is *intended* when it
+  matches the schedule contract collectives.py audits for presence —
+  data-axes grad/loss sync, TP boundary psum, the Megatron-SP f/g pair,
+  ring/pipeline permutes, expert or Ulysses all_to_alls, the ZeRO-1
+  shard round-trip. Anything else is surfaced for a human.
+- **Boundary reshards** (`predict_boundary_reshards`): compare each
+  top-level input's *declared* sharding (the abstract state/batch leaves
+  carry NamedShardings) against the partitioning the program *uses* at
+  shard_map entries and `sharding_constraint` ops. A mismatch is a
+  reshard GSPMD must mint; the finding names the exact spec change that
+  removes it. The predicted volume (full logical tensor bytes) feeds the
+  ICI cost model so the planner prices unintended collectives the same
+  as intended ones.
+
+`audit_dataflow` composes all four into the `provenance` check that
+run_shardcheck / tools/shardcheck.py --provenance / the train.py preflight
+run. Findings are warnings, never errors: an implicit reshard is a perf
+smell to burn down, not a correctness failure.
+
+Everything here is host-only abstract analysis; the one optional
+exception is `compiled_collectives` (compile the lowering and diff the
+optimized module's collectives against the StableHLO's), which tests use
+to confirm a predicted reshard really makes the partitioner mint ops.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass
+
+from picotron_tpu.analysis.collectives import parse_collectives
+from picotron_tpu.analysis.report import INFO, WARNING, Report
+
+CHECK = "provenance"
+
+# jaxpr collective primitive -> the normalized collectives.KINDS vocabulary
+_PRIM_KINDS = {
+    "psum": "all_reduce",
+    "pmean": "all_reduce",
+    "pmax": "all_reduce",
+    "pmin": "all_reduce",
+    "all_gather": "all_gather",
+    "pgather": "all_gather",
+    "psum_scatter": "reduce_scatter",
+    "reduce_scatter": "reduce_scatter",
+    "ppermute": "collective_permute",
+    "pshuffle": "collective_permute",
+    "all_to_all": "all_to_all",
+}
+
+_PKG_MARKER = "picotron_tpu" + os.sep
+
+
+@dataclass(frozen=True)
+class CollectiveSite:
+    """One collective primitive in the traced program, with provenance."""
+
+    kind: str        # normalized: a collectives.KINDS member
+    primitive: str   # the jaxpr primitive name (psum, ppermute, ...)
+    axes: tuple      # mesh axis names the op spans
+    source: str      # 'picotron_tpu/<file>:<line>' that issued it
+    scope: str       # enclosing function name (+ name_stack when present)
+    roots: tuple     # root state/batch paths whose data feeds the op
+
+    def describe(self, max_roots: int = 3) -> str:
+        roots = ", ".join(self.roots[:max_roots])
+        if len(self.roots) > max_roots:
+            roots += f", +{len(self.roots) - max_roots} more"
+        return (f"{self.primitive}{self.axes} at {self.source} "
+                f"[{self.scope}] <- {roots or '<constants>'}")
+
+
+@dataclass(frozen=True)
+class BoundaryReshard:
+    """A predicted GSPMD-minted reshard: declared spec != used spec."""
+
+    path: str        # root pytree path of the mismatched input
+    declared: str    # PartitionSpec the caller committed the array with
+    used: str        # partitioning the program applies at the boundary
+    source: str      # boundary location ('shard_map@...' / file:line)
+    nbytes: int      # full logical tensor size (the reshard volume)
+    fix: str         # the spec change that removes the reshard
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walk: sites + root-path provenance
+# ---------------------------------------------------------------------------
+
+
+def _axis_names(params: dict) -> tuple:
+    axes = params.get("axes", params.get("axis_name", ()))
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    return tuple(a for a in axes if isinstance(a, str))
+
+
+def _site_location(eqn) -> tuple:
+    """('picotron_tpu/<file>:<line>', scope) from the equation's traceback —
+    the first frame inside the package is the line that issued the op."""
+    tb = getattr(eqn.source_info, "traceback", None)
+    frames = getattr(tb, "frames", None) or ()
+    for f in frames:
+        if _PKG_MARKER in f.file_name:
+            rel = f.file_name.rsplit(_PKG_MARKER, 1)[-1]
+            scope = f.function_name
+            stack = str(getattr(eqn.source_info, "name_stack", "") or "")
+            if stack:
+                scope = f"{scope}/{stack}"
+            return f"picotron_tpu/{rel}:{f.line_num}", scope
+    return "<outside picotron_tpu>", "<unknown>"
+
+
+def _sub_jaxprs(value):
+    """Open jaxprs reachable from one eqn param value (ClosedJaxpr
+    unwrapped; tuples/lists of jaxprs flattened; everything else ignored)."""
+    if hasattr(value, "eqns") and hasattr(value, "invars"):
+        yield value
+    elif hasattr(value, "jaxpr") and hasattr(value.jaxpr, "eqns"):
+        yield value.jaxpr
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            yield from _sub_jaxprs(v)
+
+
+def _read(env: dict, atom) -> frozenset:
+    # a Literal carries its value inline and feeds no provenance
+    if hasattr(atom, "val"):
+        return frozenset()
+    return env.get(atom, frozenset())
+
+
+def _walk(jaxpr, env: dict, sites: list) -> list:
+    """Record collective sites; propagate root-path provenance var→var.
+    Returns the provenance of `jaxpr`'s outvars (for sub-jaxpr mapping)."""
+    for eqn in jaxpr.eqns:
+        in_provs = [_read(env, a) for a in eqn.invars]
+        in_prov = frozenset().union(*in_provs) if in_provs else frozenset()
+        name = eqn.primitive.name
+        if name in _PRIM_KINDS:
+            src, scope = _site_location(eqn)
+            sites.append(CollectiveSite(
+                _PRIM_KINDS[name], name, _axis_names(eqn.params),
+                src, scope, tuple(sorted(in_prov))))
+        subs = [s for v in eqn.params.values() for s in _sub_jaxprs(v)]
+        for sub in subs:
+            inner: dict = {}
+            n_in = len(sub.invars)
+            if n_in == len(eqn.invars):
+                for v, p in zip(sub.invars, in_provs):
+                    inner[v] = p
+            elif n_in < len(eqn.invars):
+                # cond/while: operands trail per-branch constants — align
+                # the sub's invars to the LAST n outer invars (exact for
+                # the common while-body case; an over-approximation is
+                # applied below when even that cannot line up)
+                for v, p in zip(sub.invars, in_provs[len(in_provs) - n_in:]):
+                    inner[v] = p
+            else:
+                for v in sub.invars:
+                    inner[v] = in_prov
+            for v in getattr(sub, "constvars", ()):
+                inner.setdefault(v, frozenset())
+            out_prov = _walk(sub, inner, sites)
+            if len(out_prov) == len(eqn.outvars):
+                for v, p in zip(eqn.outvars, out_prov):
+                    env[v] = env.get(v, frozenset()) | p
+            else:
+                for v in eqn.outvars:
+                    env[v] = env.get(v, frozenset()) | in_prov
+        if not subs:
+            for v in eqn.outvars:
+                env[v] = in_prov
+    return [_read(env, a) for a in jaxpr.outvars]
+
+
+def root_paths(state, batch) -> list:
+    """Flattened (state, batch) leaf paths in jaxpr-invar order, prefixed
+    'state/' / 'batch/' — the provenance vocabulary every site reports."""
+    from picotron_tpu.analysis.spec_lint import dict_by_path
+
+    return ([f"state/{p}" for p in dict_by_path(state)]
+            + [f"batch/{p}" for p in dict_by_path(batch)])
+
+
+def collect_sites(closed_jaxpr, paths) -> list:
+    """Every collective site in a ClosedJaxpr, with provenance from the
+    top-level invars labeled by `paths` (see `root_paths`)."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    env: dict = {}
+    for var, path in zip(jaxpr.invars, paths):
+        env[var] = frozenset([path])
+    for var in jaxpr.constvars:
+        env[var] = frozenset()
+    sites: list = []
+    _walk(jaxpr, env, sites)
+    return sites
+
+
+# ---------------------------------------------------------------------------
+# Attribution: lowered ops <-> sites
+# ---------------------------------------------------------------------------
+
+
+def _axis_sizes(cfg) -> dict:
+    d = cfg.distributed
+    return {"dp": d.dp_size, "pp": d.pp_size, "ep": d.ep_size,
+            "cp": d.cp_size, "tp": d.tp_size}
+
+
+def attribute_collectives(cfg, sites, ops) -> tuple:
+    """Match effective lowered ops to sites by (kind, replica-group size).
+
+    Group collectives match a site whose mesh-axis-size product equals the
+    op's replica-group size; permutes (pair lists, not groups) match any
+    effective permute site. Many ops map to one site (a scanned layer
+    issues its psum once per iteration), so matching is count-based and
+    round-robins across equally-plausible sites. Returns
+    (attributed [(op, site)], implicit [op])."""
+    sizes = _axis_sizes(cfg)
+    by_key: dict = {}
+    for s in sites:
+        g = math.prod(sizes.get(a, 1) for a in s.axes)
+        by_key.setdefault((s.kind, g), []).append(s)
+    permute_sites = [s for s in sites if s.kind == "collective_permute"
+                     and math.prod(sizes.get(a, 1) for a in s.axes) > 1]
+    rr: dict = {}
+    attributed, implicit = [], []
+    for op in ops:
+        if op.kind == "collective_permute":
+            cands = permute_sites
+            key = ("collective_permute", None)
+        else:
+            key = (op.kind, op.group_size)
+            cands = by_key.get(key, [])
+        if cands:
+            i = rr.get(key, 0)
+            attributed.append((op, cands[i % len(cands)]))
+            rr[key] = i + 1
+        else:
+            implicit.append(op)
+    return attributed, implicit
+
+
+def intended_rule(cfg, site) -> str:
+    """The schedule-contract rule a site satisfies (None = unexplained) —
+    mirrors the presence rules audit_collectives enforces on the text."""
+    d = cfg.distributed
+    ax = set(site.axes)
+    if not ax:
+        return None
+    if site.kind == "all_reduce":
+        if ax <= {"dp", "ep", "cp"}:
+            return "data-axes grad/loss sync"
+        if ax == {"tp"}:
+            return "TP boundary psum"
+        if ax == {"pp"} and d.pp_size > 1:
+            # per-stage loss stats and pp-replicated params (embedding /
+            # final norm / lm_head) assemble their disjoint partials over
+            # the stage axis (parallel/pp.py sync_pp_replicated_grads)
+            return "pp replicated-grad/loss-stat sync"
+    if site.kind in ("all_gather", "reduce_scatter"):
+        if ax == {"tp"} and d.sequence_parallel:
+            return "Megatron-SP f/g pair"
+        if ax == {"dp"} and d.zero1:
+            return "ZeRO-1 shard round-trip"
+    if site.kind == "collective_permute":
+        if ax == {"cp"}:
+            return "ring-attention K/V shift"
+        if ax == {"pp"}:
+            return "pipeline boundary exchange"
+    if site.kind == "all_to_all":
+        if ax == {"ep"}:
+            return "expert dispatch/combine"
+        if ax == {"cp"}:
+            return "Ulysses seq<->head trade"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Boundary reshards: declared spec vs used spec
+# ---------------------------------------------------------------------------
+
+
+def _spec_str(spec) -> str:
+    return str(tuple(spec)) if spec is not None else "()"
+
+
+def _names_to_spec(names: dict, rank: int) -> tuple:
+    """A shard_map in_names dict ({dim: (axes,)}) as a PartitionSpec-shaped
+    tuple of length `rank`."""
+    out = []
+    for dim in range(rank):
+        axes = tuple(names.get(dim, ()))
+        out.append(axes[0] if len(axes) == 1 else (axes if axes else None))
+    while out and out[-1] is None:
+        out.pop()
+    return tuple(out)
+
+
+def _declared_specs(state, batch, paths) -> dict:
+    """path -> (declared spec tuple, nbytes) for every top-level leaf that
+    carries a NamedSharding (abstract leaves from init_sharded_state)."""
+    import jax
+    import jax.numpy as jnp
+
+    out = {}
+    leaves = jax.tree_util.tree_leaves((state, batch))
+    for path, leaf in zip(paths, leaves):
+        sh = getattr(leaf, "sharding", None)
+        spec = getattr(sh, "spec", None)
+        if spec is None:
+            continue
+        nbytes = (math.prod(leaf.shape)
+                  * jnp.dtype(leaf.dtype).itemsize) if leaf.shape else 0
+        out[path] = (tuple(spec), nbytes)
+    return out
+
+
+def _norm(spec: tuple) -> tuple:
+    """Trailing-None-insensitive spec comparison key."""
+    spec = tuple(spec)
+    while spec and spec[-1] is None:
+        spec = spec[:-1]
+    return spec
+
+
+def predict_boundary_reshards(cfg, closed_jaxpr, state, batch) -> list:
+    """Predicted GSPMD-minted reshards at top-level sharding boundaries.
+
+    Walks the TOP-LEVEL equations only (a boundary is where data crosses
+    from the caller's committed shardings into the program's partitioning):
+    shard_map entries compare each input's declared spec against in_names;
+    sharding_constraint ops compare against the constraint's spec when the
+    constrained value traces back to exactly one root leaf. Each mismatch
+    names the spec change that removes the reshard."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    paths = root_paths(state, batch)
+    declared = _declared_specs(state, batch, paths)
+    var_root = {v: p for v, p in zip(jaxpr.invars, paths)}
+    out: list = []
+
+    def check(path, used_spec, source):
+        if path not in declared:
+            return
+        decl, nbytes = declared[path]
+        if _norm(decl) == _norm(used_spec):
+            return
+        out.append(BoundaryReshard(
+            path, _spec_str(decl), _spec_str(used_spec), source, nbytes,
+            fix=f"commit {path} with PartitionSpec{_spec_str(used_spec)} "
+                f"(or change the program to use "
+                f"PartitionSpec{_spec_str(decl)}) so GSPMD stops minting "
+                f"the reshard"))
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "shard_map":
+            in_names = eqn.params.get("in_names", ())
+            src, _ = _site_location(eqn)
+            for atom, names in zip(eqn.invars, in_names):
+                path = (None if hasattr(atom, "val")
+                        else var_root.get(atom))
+                if path is None or not isinstance(names, dict):
+                    continue
+                rank = len(getattr(atom, "aval", atom).shape)
+                check(path, _names_to_spec(names, rank),
+                      f"shard_map@{src}")
+        elif name == "sharding_constraint":
+            sharding = eqn.params.get("sharding")
+            spec = getattr(sharding, "spec", None)
+            if spec is None:
+                continue
+            src, _ = _site_location(eqn)
+            for atom in eqn.invars:
+                path = (None if hasattr(atom, "val")
+                        else var_root.get(atom))
+                if path is not None:
+                    check(path, tuple(spec), f"sharding_constraint@{src}")
+        elif name == "pjit":
+            # transparent wrapper at the top level: look through it so the
+            # shard_map boundary one level down still sees root vars
+            for sub in _sub_jaxprs(eqn.params.get("jaxpr")):
+                if len(sub.invars) == len(eqn.invars):
+                    inner_roots = {
+                        iv: var_root[ov]
+                        for iv, ov in zip(sub.invars, eqn.invars)
+                        if not hasattr(ov, "val") and ov in var_root}
+                    out.extend(_nested_boundaries(cfg, sub, inner_roots,
+                                                  declared))
+    return out
+
+
+def _nested_boundaries(cfg, jaxpr, var_root, declared) -> list:
+    """One level of look-through for pjit-wrapped bodies (the jitted step
+    itself lowers as an outer pjit around the user function)."""
+    out: list = []
+
+    def check(path, used_spec, source):
+        decl, nbytes = declared.get(path, (None, 0))
+        if decl is None or _norm(decl) == _norm(used_spec):
+            return
+        out.append(BoundaryReshard(
+            path, _spec_str(decl), _spec_str(used_spec), source, nbytes,
+            fix=f"commit {path} with PartitionSpec{_spec_str(used_spec)} "
+                f"(or change the program to use "
+                f"PartitionSpec{_spec_str(decl)}) so GSPMD stops minting "
+                f"the reshard"))
+
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "shard_map":
+            in_names = eqn.params.get("in_names", ())
+            src, _ = _site_location(eqn)
+            for atom, names in zip(eqn.invars, in_names):
+                path = (None if hasattr(atom, "val")
+                        else var_root.get(atom))
+                if path is None or not isinstance(names, dict):
+                    continue
+                rank = len(getattr(atom, "aval", atom).shape)
+                check(path, _names_to_spec(names, rank),
+                      f"shard_map@{src}")
+        elif eqn.primitive.name == "sharding_constraint":
+            sharding = eqn.params.get("sharding")
+            spec = getattr(sharding, "spec", None)
+            if spec is None:
+                continue
+            src, _ = _site_location(eqn)
+            for atom in eqn.invars:
+                path = (None if hasattr(atom, "val")
+                        else var_root.get(atom))
+                if path is not None:
+                    check(path, tuple(spec), f"sharding_constraint@{src}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Compiled-module confirmation (optional; compiles — tests only)
+# ---------------------------------------------------------------------------
+
+
+def compiled_collectives(lowered) -> list:
+    """Effective collectives of the OPTIMIZED module — after SPMD
+    partitioning, so GSPMD-minted reshards are visible (they never appear
+    in the pre-partitioning StableHLO). Compiles the program: cheap for
+    the tiny fixtures, not for pod-scale configs."""
+    text = lowered.compile().as_text()
+    return [op for op in parse_collectives(text) if op.effective]
+
+
+# ---------------------------------------------------------------------------
+# The check
+# ---------------------------------------------------------------------------
+
+
+def audit_dataflow(cfg, *, low=None, menv=None, cost_model=None) -> Report:
+    """The `provenance` check: site collection, attribution, intended-vs-
+    implicit classification, and boundary-reshard prediction for one
+    config's train step. All findings are warnings/info — a reshard is a
+    performance smell, not a correctness failure."""
+    rep = Report()
+    if low is None:
+        from picotron_tpu.analysis.trace import lower_train_step
+
+        low = lower_train_step(cfg, menv)
+    if getattr(low, "jaxpr", None) is None:
+        rep.add(CHECK, WARNING, "<trace>",
+                "this JAX version exposes no pre-lowering jaxpr "
+                "(jit(...).trace); provenance analysis skipped")
+        rep.info[CHECK] = {"unavailable": "no jaxpr capture"}
+        return rep
+
+    paths = root_paths(low.state, low.batch)
+    sites = collect_sites(low.jaxpr, paths)
+    ops = [op for op in parse_collectives(low.text) if op.effective]
+    attributed, implicit = attribute_collectives(cfg, sites, ops)
+    reshards = predict_boundary_reshards(cfg, low.jaxpr, low.state,
+                                         low.batch)
+
+    by_rule: dict = {}
+    unexplained_sites = []
+    for op, site in attributed:
+        rule = intended_rule(cfg, site)
+        if rule is None:
+            unexplained_sites.append((op, site))
+        else:
+            by_rule[rule] = by_rule.get(rule, 0) + 1
+
+    by_source: dict = {}
+    for op, site in attributed:
+        row = by_source.setdefault(site.source, {"ops": 0, "kinds": set(),
+                                                 "roots": site.roots})
+        row["ops"] += 1
+        row["kinds"].add(op.kind)
+
+    for op in implicit:
+        rep.add(CHECK, WARNING, f"{op.kind}@L{op.line}",
+                f"collective ({op.kind}, group {op.group_size}, "
+                f"{op.nbytes or '?'} bytes) has no source site in the "
+                f"traced program — GSPMD-minted implicit reshard; check "
+                f"the PartitionSpecs of the tensors reaching this op "
+                f"(shardcheck --provenance shows the declared-vs-used "
+                f"boundary table)")
+    for r in reshards:
+        rep.add(CHECK, WARNING, r.path,
+                f"implicit reshard at {r.source}: declared "
+                f"PartitionSpec{r.declared} but the program uses "
+                f"PartitionSpec{r.used} ({r.nbytes} bytes re-laid per "
+                f"call) — {r.fix}")
+    for op, site in unexplained_sites[:8]:
+        rep.add(CHECK, INFO, site.source,
+                f"collective outside the declared schedule contract: "
+                f"{site.describe()}")
+
+    n_attr = len(attributed)
+    n_ops = len(ops)
+    info = {
+        "sites": len(sites),
+        "ops_effective": n_ops,
+        "ops_attributed": n_attr,
+        "attribution_pct": round(100.0 * n_attr / n_ops, 1) if n_ops
+        else 100.0,
+        "implicit_ops": len(implicit),
+        "boundary_reshards": len(reshards),
+        "intended_by_rule": dict(sorted(by_rule.items())),
+        "unexplained_sites": len(unexplained_sites),
+        "by_source": {src: {"ops": row["ops"],
+                            "kinds": sorted(row["kinds"]),
+                            "roots": list(row["roots"][:4])}
+                      for src, row in sorted(by_source.items())},
+    }
+    if cost_model is not None and (implicit or reshards):
+        # price the unintended traffic exactly like the intended schedule:
+        # the planner must see reshard cost, not just op counts
+        priced = cost_model.price_ops(cfg, implicit)
+        implicit_s = sum(p["secs"] for p in priced)
+        reshard_s, reshard_bytes = cost_model.price_reshards(cfg, reshards)
+        info["implicit_comm_ms"] = round((implicit_s + reshard_s) * 1e3, 4)
+        info["implicit_bytes"] = (sum(p["bytes"] for p in priced)
+                                  + reshard_bytes)
+    rep.info[CHECK] = info
+    return rep

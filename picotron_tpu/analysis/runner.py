@@ -13,8 +13,10 @@ import os
 
 from picotron_tpu.analysis.report import Report
 
-ALL_CHECKS = ("spec", "source", "collectives", "donation", "stability")
-PREFLIGHT_CHECKS = ("spec", "donation", "stability")
+ALL_CHECKS = ("spec", "source", "collectives", "provenance", "variants",
+              "donation", "stability")
+PREFLIGHT_CHECKS = ("spec", "donation", "stability", "provenance",
+                    "variants")
 
 
 def run_shardcheck(cfg, *, menv=None, checks=ALL_CHECKS,
@@ -39,7 +41,8 @@ def run_shardcheck(cfg, *, menv=None, checks=ALL_CHECKS,
         from picotron_tpu.analysis.source_lint import lint_sources
 
         rep.extend(lint_sources(source_roots))
-    trace_checks = {"collectives", "donation", "stability"} & set(checks)
+    trace_checks = {"collectives", "provenance", "variants", "donation",
+                    "stability"} & set(checks)
     if trace_checks:
         if not spec_ok:
             # a spec the lint rejects usually cannot trace either — stop at
@@ -56,6 +59,14 @@ def run_shardcheck(cfg, *, menv=None, checks=ALL_CHECKS,
                                          state=low.state,
                                          budget_bytes=budget_bytes,
                                          cost_model=cost_model))
+        if "provenance" in trace_checks:
+            from picotron_tpu.analysis.dataflow import audit_dataflow
+
+            rep.extend(audit_dataflow(cfg, low=low, cost_model=cost_model))
+        if "variants" in trace_checks:
+            from picotron_tpu.analysis.variants import audit_variants
+
+            rep.extend(audit_variants(cfg, low=low))
         if "donation" in trace_checks:
             from picotron_tpu.analysis.hazards import check_donation
 

@@ -29,6 +29,12 @@ source itself, on paths a given trace never visits. Rules:
   loop builds the function once, the scan issues the op. Deliberate
   unrolled rings (ops/ring_attention.py's cp-hop chain) suppress
   per-line.
+- **uncommitted-device-put** (warning): `jax.device_put(x)` with no
+  sharding/device argument. It produces an UNCOMMITTED array — jax keys
+  jit executables on commitment, so feeding it where a committed array
+  previously flowed mints a second executable for identical shapes (the
+  variant hazard analysis/variants.py proves against). Pass the sharding
+  explicitly, or use `jax.device_put(x, device=...)`.
 
 Suppress a finding with a `# shardcheck: ok` comment on the line.
 """
@@ -97,6 +103,20 @@ class _Visitor(ast.NodeVisitor):
 
     def visit_Call(self, node):
         chain = _attr_chain(node.func)
+        if (chain and chain[-1] == "device_put"
+                and chain[0] in ("jax", "device_put")
+                and len(node.args) < 2
+                and not any(kw.arg in ("device", "shardings")
+                            for kw in node.keywords)):
+            self._add(node, WARNING,
+                      "jax.device_put without an explicit sharding "
+                      "produces an UNCOMMITTED array — a later feed of it "
+                      "where a committed array flowed keys a second jit "
+                      "executable for the same shapes (the variant hazard "
+                      "analysis/variants.py proves against). Pass the "
+                      "sharding positionally or as device=..., or "
+                      "suppress with '# shardcheck: ok' if uncommitted "
+                      "placement is deliberate")
         if (self._loop_depth > 0 and chain
                 and chain[-1] in _COLLECTIVES
                 and chain[0] in ("jax", "lax")):

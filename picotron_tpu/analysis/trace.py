@@ -21,6 +21,8 @@ class LoweredStep(NamedTuple):
     text: str            # StableHLO module text
     state: object        # abstract TrainState
     batch: tuple         # abstract (ids, targets)
+    jaxpr: object = None  # ClosedJaxpr pre-lowering (provenance analysis);
+    #                       None when this JAX lacks jit(...).trace
 
 
 def abstract_batch(cfg, menv):
@@ -45,5 +47,14 @@ def lower_train_step(cfg, menv=None) -> LoweredStep:
     state = init_sharded_state(cfg, menv, jax.random.key(0), abstract=True)
     step = make_train_step(cfg, menv)
     batch = abstract_batch(cfg, menv)
-    lowered = step.lower(state, batch)
-    return LoweredStep(step, lowered, lowered.as_text(), state, batch)
+    # one trace serves both consumers: the jaxpr (sharding-dataflow
+    # provenance, analysis/dataflow.py) and the lowering (HLO-text checks)
+    jaxpr = None
+    if hasattr(step, "trace"):
+        traced = step.trace(state, batch)
+        jaxpr = traced.jaxpr
+        lowered = traced.lower()
+    else:  # older JAX: no Traced stage — lower directly, skip provenance
+        lowered = step.lower(state, batch)
+    return LoweredStep(step, lowered, lowered.as_text(), state, batch,
+                       jaxpr)

@@ -248,6 +248,15 @@ class ServeEngine:
         self.cos = jax.device_put(self.cos, self._rep_sh)
         self.sin = jax.device_put(self.sin, self._rep_sh)
         self.base_key = jax.device_put(self.base_key, self._rep_sh)
+        # ... and the params themselves: raw init_params / checkpoint
+        # loads hand over uncommitted arrays, the one hole the variant
+        # prover (analysis/variants.check_engine_feed) found in this
+        # discipline — an uncommitted re-feed of the same shapes would
+        # mint a second executable. Already-committed leaves (e.g.
+        # place_for_decode output) pass through untouched.
+        self.params = jax.tree.map(
+            lambda x: x if getattr(x, "committed", True)
+            else jax.device_put(x, self._rep_sh), self.params)
         # host mirror of the device block tables; sentinel = num_blocks
         self._tables = np.full((self.num_slots, self.max_blocks),
                                self.num_blocks, np.int32)
@@ -271,6 +280,22 @@ class ServeEngine:
             "output_tokens": 0, "prefill_tokens": 0,
         }
         self._next_auto_id = 0
+
+        # Static variant-prover check over the feed the engine just built
+        # (analysis/variants.py): every persistent leaf must be committed,
+        # or the first decode after an uncommitted re-feed mints a second
+        # executable for the same shapes. Advisory — findings go to
+        # telemetry, never raise; the runtime CompileWatch twin
+        # (stats["decode_compiles"]) remains the ground truth.
+        try:
+            from picotron_tpu.analysis.variants import check_engine_feed
+
+            self.variant_report = check_engine_feed(self)
+            for f in self.variant_report.warnings():
+                self.telemetry.emit("variant_hazard", category="serve",
+                                    path=f.path, message=f.message)
+        except Exception:  # analysis is best-effort at serve time
+            self.variant_report = None
 
     # -- intake ------------------------------------------------------------
 

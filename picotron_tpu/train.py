@@ -190,6 +190,24 @@ def main(argv=None) -> None:
         pre = preflight(cfg, menv)  # raises ShardcheckError with the report
         log_print(f"shardcheck preflight: ok "
                   f"({len(pre.warnings())} warning(s))")
+        # Surface the sharding-dataflow audit verbatim: an implicit
+        # (GSPMD-minted) reshard or an unproven jit entry is a perf smell
+        # the operator should see at startup, each with the spec fix named
+        # (analysis/dataflow.py, analysis/variants.py).
+        for f in pre.warnings():
+            if f.check in ("provenance", "variants"):
+                log_print(f"shardcheck preflight WARNING: {f.render()}")
+        prov = pre.info.get("provenance", {})
+        if prov.get("sites") is not None:
+            log_print(
+                f"shardflow: {prov['ops_attributed']}/"
+                f"{prov['ops_effective']} collective(s) attributed, "
+                f"{prov['implicit_ops']} implicit, "
+                f"{prov['boundary_reshards']} predicted reshard(s)")
+        ts = pre.info.get("variants", {}).get("train_step", {})
+        if ts.get("proven"):
+            log_print("shardflow: train step proven compile-once "
+                      f"({ts['leaves']} abstract leaves, 1 signature)")
         if cfg.checkpoint.save_frequency > 0:
             # Same fail-fast contract for the checkpoint store: an
             # unwritable save_dir or a disk without headroom for one

@@ -6,6 +6,9 @@ acceptance criteria: non-divisible tp sharding, extra/missing spec leaf,
 undonated state buffer)."""
 
 import dataclasses
+import json
+import os
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -443,3 +446,164 @@ def test_preflight_env_escape_hatch(monkeypatch):
                             "preflight must be skipped")))
     rep = preflight(mkcfg())
     assert rep.ok() and not rep.findings
+
+
+# ---------------------------------------------------------------------------
+# donation edge cases (shardflow satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_donation_aliased_into_two_outputs():
+    """A donated buffer whose ORIGINAL also escapes as a second output:
+    XLA can alias it into at most one, but the donation *request* is what
+    the static check audits — it stays recorded on every leaf, through
+    both the args_info path and the HLO-text fallback. The runtime cost of
+    the unusable alias is the CompileWatch/goodput layer's to observe."""
+    state, batch = _toy_state_batch()
+
+    def step(s, b):
+        new = jax.tree.map(lambda x: x + b[0].sum(), s)
+        return new, s  # the donated inputs escape unmodified too
+
+    low = jax.jit(step, donate_argnums=(0,)).lower(state, batch)
+    rep = check_donation(low, state, batch)
+    assert rep.ok(), rep.render(verbose=True)
+    assert rep.info["donation"]["donated"] == \
+        rep.info["donation"]["state_leaves"] == 3
+    # text-fallback parity on the same module
+    rep_text = check_donation(low.as_text(), state, batch)
+    assert rep_text.ok(), rep_text.render(verbose=True)
+    assert rep_text.info["donation"] == rep.info["donation"]
+
+
+def test_donation_text_fallback_parity_under_compat_shim():
+    """This JAX (no varying-manual-axes) lowers the step through the
+    compat.py pre-vma shard_map shim; donation attributes must survive
+    that path identically in the Lowered.args_info view and the raw
+    StableHLO text view (the fallback older jax versions take)."""
+    from picotron_tpu.compat import HAS_VMA
+
+    cfg = mkcfg(dist=dict(pp_size=2, dp_size=2), ga=2)
+    low = lower_train_step(cfg)
+    rep_info = check_donation(low.lowered, low.state, low.batch)
+    rep_text = check_donation(low.text, low.state, low.batch)
+    assert rep_info.ok(), rep_info.render(verbose=True)
+    assert rep_info.info["donation"] == rep_text.info["donation"]
+    assert rep_info.info["donation"]["donated"] == \
+        rep_info.info["donation"]["state_leaves"]
+    if not HAS_VMA:  # the shim path really was exercised
+        assert rep_text.ok(), rep_text.render(verbose=True)
+
+
+def test_donation_full_coverage_through_fused_bwd():
+    """The fused grad engine's manual backward must not cost donation on
+    any TrainState leaf — its scan carries grads through jaxpr-level
+    custom plumbing that once made the aliaser lose track."""
+    cfg = _fused_sp_cfg()
+    low = lower_train_step(cfg)
+    rep = check_donation(low.lowered, low.state, low.batch)
+    assert rep.ok(), rep.render(verbose=True)
+    assert rep.info["donation"]["donated"] == \
+        rep.info["donation"]["state_leaves"]
+
+
+# ---------------------------------------------------------------------------
+# source lint: uncommitted device_put (shardflow satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_source_lint_flags_uncommitted_device_put(tmp_path):
+    """jax.device_put with no sharding/device produces an UNCOMMITTED
+    array (the variant hazard); with an explicit placement, a device=
+    kwarg, or a suppression it passes — positive and negative halves."""
+    bad = tmp_path / "puts.py"
+    bad.write_text(
+        "import jax\n"
+        "from jax import device_put\n"
+        "def feed(x, sh):\n"
+        "    a = jax.device_put(x)\n"                       # line 4: flags
+        "    b = jax.device_put(x, sh)\n"                   # positional ok
+        "    c = jax.device_put(x, device=sh)\n"            # kwarg ok
+        "    d = device_put(x)\n"                           # line 7: flags
+        "    e = jax.device_put(x)  # shardcheck: ok\n"     # suppressed
+        "    return a, b, c, d, e\n")
+    rep = lint_sources([str(bad)])
+    assert rep.ok()  # warnings, not errors
+    hits = [f for f in rep.warnings() if "UNCOMMITTED" in f.message]
+    lines = sorted(int(f.path.rsplit(":", 1)[1]) for f in hits)
+    assert lines == [4, 7], rep.render(verbose=True)
+
+
+def test_source_lint_repo_has_no_uncommitted_device_puts():
+    """The rule holds repo-wide: every device_put in picotron_tpu/ passes
+    an explicit sharding (serve/engine.py's commit-everything discipline,
+    checkpoint restore placement, offload host transfers)."""
+    rep = lint_sources()
+    assert not [f for f in rep.warnings()
+                if "UNCOMMITTED" in f.message], rep.render(verbose=True)
+
+
+# ---------------------------------------------------------------------------
+# runs/ preset gate (shardflow tier-1 regression fence)
+# ---------------------------------------------------------------------------
+
+
+def test_shardflow_runs_gate():
+    """Provenance + variant audit over every shipped runs/ preset, fenced
+    against tests/data/shardflow_baseline.json. Fails on REGRESSIONS
+    only: a NEW implicit reshard or predicted boundary reshard, a proven
+    jit entry turning unproven, attribution decaying below the 90%
+    acceptance bar, or a config newly failing to trace. Improvements
+    (e.g. a pre-vma-fatal config starting to trace on a newer jax) pass —
+    regenerate the baseline to lock them in."""
+    import subprocess
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    with open(os.path.join(os.path.dirname(__file__), "data",
+                           "shardflow_baseline.json")) as f:
+        baseline = json.load(f)["configs"]
+    cfgs = sorted(
+        __import__("glob").glob(os.path.join(root, "runs", "*",
+                                             "config.json")))
+    assert cfgs, "runs/ presets missing"
+    args = [sys.executable, os.path.join(root, "tools", "shardcheck.py"),
+            "--provenance", "--variants", "--json"]
+    for c in cfgs:
+        args += ["--config", c]
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(args, capture_output=True, text=True, env=env,
+                         timeout=540, cwd=root)
+    rows = [json.loads(line) for line in res.stdout.strip().splitlines()]
+    assert len(rows) == len(cfgs), res.stderr[-2000:]
+
+    problems = []
+    for row in rows:
+        name = os.path.basename(os.path.dirname(row["config"]))
+        base = baseline.get(name)
+        assert base is not None, f"new preset {name}: add it to the baseline"
+        if "fatal" in row:
+            if base["status"] != "fatal":
+                problems.append(f"{name}: newly fatal — {row['fatal']}")
+            continue
+        if base["status"] == "fatal":
+            continue  # improvement: traces now where it could not before
+        prov = row["info"]["provenance"]
+        var = row["info"]["variants"]
+        if prov["implicit_ops"] > base["implicit_ops"]:
+            problems.append(f"{name}: {prov['implicit_ops']} implicit "
+                            f"collective(s), baseline "
+                            f"{base['implicit_ops']}")
+        if prov["boundary_reshards"] > base["boundary_reshards"]:
+            problems.append(f"{name}: {prov['boundary_reshards']} predicted "
+                            f"boundary reshard(s), baseline "
+                            f"{base['boundary_reshards']}")
+        if prov["attribution_pct"] < 90.0:
+            problems.append(f"{name}: attribution "
+                            f"{prov['attribution_pct']}% < 90%")
+        for entry in ("train_step", "serve"):
+            if (base[f"{entry}_proven"]
+                    and not var.get(entry, {}).get("proven")):
+                problems.append(f"{name}: {entry} no longer proven "
+                                f"compile-once")
+    assert not problems, "\n".join(problems)

@@ -12,13 +12,28 @@ configs by abstract evaluation on simulated host devices:
 - donation + recompilation hazards: every TrainState buffer donated; the
   step's output avals identical to its inputs (anything else recompiles
   every step)
-- source lint: no semi-private jax.core, no host callbacks in library code
+- sharding-dataflow audit (--provenance): attribute every lowered
+  collective to the source line + state/batch paths that minted it,
+  classify each as intended (schedule contract) or implicit
+  (GSPMD-minted reshard), and predict boundary reshards with the spec
+  fix named
+- jit-variant prover (--variants): statically enumerate the abstract
+  signatures (shape/dtype/sharding/commitment) reaching each jit entry
+  point — train step, serve prefill/decode — and prove compile-once
+- source lint: no semi-private jax.core, no host callbacks in library
+  code, no uncommitted jax.device_put
 
 Usage:
 
   python tools/shardcheck.py --config runs/smollm17-dp8/config.json
   python tools/shardcheck.py --preset tiny-dense --preset tiny-moe-ep
   python tools/shardcheck.py --all-presets --verbose
+  python tools/shardcheck.py --all-presets --provenance --variants --json
+
+--json emits one machine-readable line per config for every subcommand
+(findings + the per-check info dict); a config that cannot trace at all
+on this JAX becomes a row with a "fatal" key instead of killing the
+sweep.
 
 Exit status 0 iff every config is green. The preset matrix covers the
 layouts the test tier exercises (dense/MoE, pp>1, ep>1, offload on/off) on
@@ -98,9 +113,18 @@ def main(argv=None) -> int:
                     help="run the full preset matrix (dense/MoE, pp>1, "
                          "ep>1, offload on/off)")
     ap.add_argument("--checks", default=None,
-                    help="comma-separated subset of "
-                         "spec,source,collectives,donation,stability "
-                         "(default: all)")
+                    help="comma-separated subset of spec,source,"
+                         "collectives,provenance,variants,donation,"
+                         "stability (default: all)")
+    ap.add_argument("--provenance", action="store_true",
+                    help="focus on the sharding-dataflow audit: collective "
+                         "provenance, intended-vs-implicit classification, "
+                         "predicted boundary reshards (spec lint still "
+                         "runs first)")
+    ap.add_argument("--variants", action="store_true",
+                    help="focus on the static jit-variant prover: abstract "
+                         "signatures reaching each jit entry point, "
+                         "compile-once proof (spec lint still runs first)")
     ap.add_argument("--budget-mb", type=float, default=None,
                     help="all-gather replication budget in MiB (default: "
                          "the largest param leaf / activation block)")
@@ -128,8 +152,14 @@ def main(argv=None) -> int:
     from picotron_tpu.analysis import ALL_CHECKS, run_shardcheck
     from picotron_tpu.config import load_config
 
-    checks = (tuple(c.strip() for c in args.checks.split(","))
-              if args.checks else ALL_CHECKS)
+    if args.checks:
+        checks = tuple(c.strip() for c in args.checks.split(","))
+    elif args.provenance or args.variants:
+        checks = ("spec",)
+        checks += ("provenance",) if args.provenance else ()
+        checks += ("variants",) if args.variants else ()
+    else:
+        checks = ALL_CHECKS
     unknown = set(checks) - set(ALL_CHECKS)
     if unknown:
         ap.error(f"unknown checks {sorted(unknown)}; valid: {ALL_CHECKS}")
@@ -159,8 +189,20 @@ def main(argv=None) -> int:
 
     n_bad = 0
     for label, cfg in targets:
-        rep = run_shardcheck(cfg, checks=checks, budget_bytes=budget,
-                             cost_model=cost_model)
+        try:
+            rep = run_shardcheck(cfg, checks=checks, budget_bytes=budget,
+                                 cost_model=cost_model)
+        except Exception as e:  # layouts this JAX cannot trace (pre-vma)
+            n_bad += 1
+            if args.json:
+                print(json.dumps({
+                    "config": label, "ok": False,
+                    "fatal": f"{type(e).__name__}: {e}",
+                }), flush=True)
+            else:
+                print(f"== {label} ==")
+                print(f"FATAL {type(e).__name__}: {e}", flush=True)
+            continue
         cost_row = None
         if cost_model is not None:
             from picotron_tpu.analysis.planner import planner_gap
@@ -190,6 +232,32 @@ def main(argv=None) -> int:
         else:
             print(f"== {label} ==")
             print(rep.render(verbose=args.verbose), flush=True)
+            prov = rep.info.get("provenance")
+            if prov and "sites" in prov:
+                print(f"provenance: {prov['sites']} site(s), "
+                      f"{prov['ops_attributed']}/{prov['ops_effective']} "
+                      f"lowered op(s) attributed "
+                      f"({prov['attribution_pct']:.1f}%), "
+                      f"{prov['implicit_ops']} implicit, "
+                      f"{prov['boundary_reshards']} predicted reshard(s)",
+                      flush=True)
+                if args.verbose:
+                    for src in sorted(prov.get("by_source", {})):
+                        row = prov["by_source"][src]
+                        roots = ", ".join(row["roots"][:3]) or "<constants>"
+                        print(f"  {src}: {row['ops']} "
+                              f"{'/'.join(row['kinds'])} <- {roots}",
+                              flush=True)
+            var = rep.info.get("variants")
+            if var:
+                for entry in ("train_step", "serve"):
+                    v = var.get(entry) or {}
+                    if "proven" in v:
+                        state = ("proven compile-once" if v["proven"]
+                                 else "NOT proven")
+                        print(f"variants[{v.get('entry', entry)}]: {state} "
+                              f"({v.get('signatures', '?')} abstract "
+                              f"signature(s))", flush=True)
             if cost_row:
                 line = (f"cost[{cost_row['generation']}]: predicted step "
                         f"{cost_row['predicted_step_ms']} ms (exposed "
