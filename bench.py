@@ -463,6 +463,119 @@ def run_serve(model: str, layers, *, slots: int, block_size: int,
     }
 
 
+def run_pp_tick_sweep(model: str, layers, seq: int, mbs: int, *,
+                      pp: int = 4, n_micros=(2, 4, 8, 16), steps: int = 4,
+                      warmup: int = 1, interleave: int = 2) -> dict:
+    """SPMD-vs-MPMD pipeline tick cost: time the train step at several
+    microbatch counts and fit step_ms = slope * n_micro + intercept per
+    executor — the PERF.md r4 instrument (whose hand-fit put the SPMD
+    fill/drain intercept at ~454 ms for pp=4 on simulated devices),
+    automated. The slope is the per-tick steady-state cost; the intercept
+    is the fill/drain + fixed overhead — the number the MPMD executor
+    exists to shrink, because the SPMD lockstep scan pays ~a full traced
+    tick per idle schedule slot while the host-side walker pays ~nothing.
+    One JSON line per (executor, n_micro) sample, then a summary line
+    with both fits, the intercept drop, and the schedule-table tick
+    accounting (where interleaved-v2 must beat 1f1b at pp=4)."""
+    from picotron_tpu.config import (
+        Config, DistributedConfig, ModelConfig, PipelineConfig,
+        TrainingConfig, resolve_preset,
+    )
+    from picotron_tpu.mesh import MeshEnv
+    from picotron_tpu.parallel.api import init_sharded_state, make_train_step
+    from picotron_tpu.parallel.mpmd import schedule_stats
+
+    n_chips = len(jax.devices())
+    if n_chips % pp != 0 or n_chips < pp:
+        raise SystemExit(f"--pp-tick-sweep: {n_chips} device(s) not "
+                         f"divisible into pp={pp} stages")
+    dp = n_chips // pp
+    preset = resolve_preset(model)
+    preset["max_position_embeddings"] = max(
+        preset.get("max_position_embeddings", seq), seq)
+    if layers:
+        preset["num_hidden_layers"] = layers
+    depth = preset["num_hidden_layers"]
+    metric = f"pp_tick_sweep_{model.split('/')[-1]}-{depth}L_pp{pp}"
+
+    def timed(executor: str, n_micro: int) -> float:
+        cfg = Config(
+            distributed=DistributedConfig(dp_size=dp, pp_size=pp),
+            model=ModelConfig(name=model, **preset),
+            training=TrainingConfig(seq_length=seq, micro_batch_size=mbs,
+                                    gradient_accumulation_steps=n_micro),
+            pipeline=(PipelineConfig(executor="mpmd")
+                      if executor == "mpmd" else PipelineConfig()),
+        )
+        cfg.validate()
+        menv = MeshEnv.from_config(cfg)
+        state = init_sharded_state(cfg, menv, jax.random.key(0))
+        step = make_train_step(cfg, menv)
+        toks = jax.random.randint(jax.random.key(1),
+                                  (n_micro, mbs * dp, seq + 1),
+                                  0, cfg.model.vocab_size)
+        sharding = menv.batch_sharding()
+        batch = (jax.device_put(toks[..., :-1], sharding),
+                 jax.device_put(toks[..., 1:], sharding))
+        for _ in range(max(warmup, 1)):
+            state, metrics = step(state, batch)
+        float(metrics["loss"])  # drain the warmup chain
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, batch)
+        float(metrics["loss"])  # value fetch: every step must have run
+        return (time.perf_counter() - t0) / steps * 1e3
+
+    fits = {}
+    for executor in ("spmd", "mpmd"):
+        xs, ys = [], []
+        for n_micro in n_micros:
+            step_ms = timed(executor, n_micro)
+            xs.append(float(n_micro))
+            ys.append(step_ms)
+            print(json.dumps({"metric": metric, "executor": executor,
+                              "n_micro": n_micro,
+                              "step_time_ms": round(step_ms, 2)}),
+                  flush=True)
+        n = len(xs)
+        mx, my = sum(xs) / n, sum(ys) / n
+        var = sum((x - mx) ** 2 for x in xs) or 1.0
+        slope = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / var
+        fits[executor] = {
+            "ms_per_microbatch": round(slope, 2),
+            "intercept_ms": round(my - slope * mx, 2),
+        }
+
+    # Schedule-table accounting (host arithmetic, parallel/mpmd.py): the
+    # idle units each schedule implies at the sweep's largest n_micro —
+    # in FULL units (1 unit = one stage's F+B), executor-independent.
+    nm = max(n_micros)
+    acct = {k: schedule_stats(k, nm, pp) for k in ("spmd", "1f1b", "gpipe")}
+    slots = -(-depth // pp)  # ceil
+    if interleave >= 2 and slots % interleave == 0:
+        acct[f"interleaved-v{interleave}"] = schedule_stats(
+            "interleaved", nm, pp, interleave)
+    acct["zb"] = schedule_stats("zb", nm, pp)
+
+    drop_ms = fits["spmd"]["intercept_ms"] - fits["mpmd"]["intercept_ms"]
+    base = fits["spmd"]["intercept_ms"]
+    row = {
+        "metric": metric,
+        "value": round(drop_ms / base, 4) if base else None,
+        "unit": "mpmd_intercept_drop_fraction",
+        "intercept_drop_ms": round(drop_ms, 2),
+        "spmd": fits["spmd"],
+        "mpmd": fits["mpmd"],
+        "n_micros": list(n_micros),
+        "pp": pp, "dp": dp, "mbs": mbs, "seq": seq,
+        "schedule_bubble_units": {
+            k: round(v["bubble_units"], 3) for k, v in acct.items()},
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
 def run_bwd_grid_sweep(model: str, seq: int, batch: int, steps: int = 5,
                        blocks=None) -> list:
     """Block-size sweep of the flash attention KERNEL PAIR (fwd, fwd+bwd)
@@ -664,6 +777,21 @@ def main() -> None:
                     help="--serve: decode steps scanned inside one "
                          "dispatch (amortizes host overhead; retirement "
                          "latency quantizes to it)")
+    ap.add_argument("--pp-tick-sweep", action="store_true",
+                    help="fit step time vs n_micro per pipeline executor "
+                         "(SPMD lockstep scan vs MPMD per-stage programs) "
+                         "at --pp stages: slope = ms/tick, intercept = "
+                         "fill/drain + fixed overhead — the PERF.md r4 "
+                         "table, automated, with the MPMD column. One "
+                         "JSON line per sample + a summary line with the "
+                         "intercept drop and schedule-tick accounting. "
+                         "Needs a device count divisible by --pp (use "
+                         "--cpu for 8 simulated hosts)")
+    ap.add_argument("--pp", type=int, default=4,
+                    help="--pp-tick-sweep: pipeline stages")
+    ap.add_argument("--n-micros", type=int, nargs="*",
+                    default=[2, 4, 8, 16],
+                    help="--pp-tick-sweep: microbatch counts to fit over")
     ap.add_argument("--bwd-grid-sweep", action="store_true",
                     help="sweep flash-attention (block_q, block_k) over "
                          "the fwd / fwd+bwd kernel pair at --seq (use "
@@ -676,15 +804,36 @@ def main() -> None:
                          "when no TPU backend is reachable")
     args = ap.parse_args()
 
+    if args.pp_tick_sweep and args.cpu:
+        # Provision the simulated stage x data devices BEFORE the first
+        # backend-initializing jax call (require_backend's jax.devices()
+        # pins the client) — same ordering contract as tools/memcheck.py.
+        from picotron_tpu.mesh import force_host_device_count
+
+        force_host_device_count(max(args.pp, 8))
+
     # Backend probe BEFORE any mode: a down TPU tunnel must be one line,
     # not the xla_bridge traceback BENCH_r05.json recorded. Children of
     # --sweep inherit the pinned JAX_PLATFORMS via the environment.
     require_backend(args.cpu)
 
     if args.shardcheck and (args.sweep or args.decode or args.profile
-                            or args.bwd_grid_sweep or args.serve):
+                            or args.bwd_grid_sweep or args.serve
+                            or args.pp_tick_sweep):
         ap.error("--shardcheck is its own mode; incompatible with "
-                 "--sweep/--decode/--profile/--bwd-grid-sweep/--serve")
+                 "--sweep/--decode/--profile/--bwd-grid-sweep/--serve/"
+                 "--pp-tick-sweep")
+
+    if args.pp_tick_sweep:
+        if (args.sweep or args.decode or args.profile
+                or args.bwd_grid_sweep or args.serve):
+            ap.error("--pp-tick-sweep is its own mode; incompatible with "
+                     "--sweep/--decode/--profile/--bwd-grid-sweep/--serve")
+        run_pp_tick_sweep(args.model, args.layers or 0, args.seq,
+                          args.mbs or 1, pp=args.pp,
+                          n_micros=tuple(args.n_micros),
+                          steps=args.steps, warmup=args.warmup)
+        return
 
     if args.serve:
         if args.sweep or args.decode or args.profile or args.bwd_grid_sweep:

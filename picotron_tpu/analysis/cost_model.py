@@ -23,8 +23,9 @@ Three parts:
   ppermute V) plus an α·hops latency term, per axis placement. `price_ops`
   applies them to the `CollectiveOp` list parsed off a traced schedule.
 - **Step model** (`CostModel.predict`): the analytic whole-step time —
-  compute (calibrated dense/attention efficiencies), the 1f1b pipeline
-  bubble (pp-1)/ga, optimizer-offload PCIe streaming, and the per-class
+  compute (calibrated dense/attention efficiencies), the executor-dependent
+  pipeline bubble (spmd lockstep 2(pp-1)/ga; mpmd (pp-1)/(v·ga) plus
+  host-dispatch), optimizer-offload PCIe streaming, and the per-class
   comm terms with exposed-fraction weights (a grad all-reduce overlaps the
   backward; an in-layer TP psum does not). Constants live in `Calibration`
   and are fitted against the measured SWEEP/BENCH rows on disk by
@@ -187,6 +188,12 @@ class Calibration:
     # fraction of each comm class NOT hidden under compute
     expose_grad: float = 0.35   # grad all-reduce overlaps the backward
     expose_pp: float = 0.5      # boundary ppermute overlaps the 1f1b scan
+    # MPMD executor: host-side cost of dispatching one per-stage program
+    # (schedule-table walk + jit cache hit + device_put enqueue). Replaces
+    # the SPMD scan's full-priced idle tick — the r4 intercept said an
+    # SPMD idle tick costs ~a traced unit (~64.7 ms); a host dispatch is
+    # ~0.2 ms. Analytic default awaiting --pp-tick-sweep calibration.
+    host_dispatch_s: float = 2.0e-4
     expose_layer: float = 1.0   # in-layer tp/sp/cp/ep collectives serialize
     # step-FLOPs multiplier per remat policy (recompute overhead), relative
     # to "dots" whose overhead the efficiency fit absorbs
@@ -240,7 +247,8 @@ class StepCost:
     n_chips: int
     tokens_per_step: int
     compute_s: float
-    bubble_s: float      # 1f1b fill/drain: compute * (pp-1)/ga
+    bubble_s: float      # pipeline bubble: spmd 2(pp-1)/ga of compute;
+    #                      mpmd (pp-1)/(v*ga) + host dispatch
     offload_s: float     # optimizer-offload PCIe streaming
     comm: tuple          # CommTerm, ...
 
@@ -447,8 +455,24 @@ class CostModel:
                      * (f_dense_tok / eff_d + f_attn_tok / c.eff_attn)
                      / (world * self.gen.peak_flops))
 
-        # 1f1b / afab fill+drain bubble: total = ideal * (ga + pp - 1)/ga
-        bubble_s = compute_s * (d.pp_size - 1) / ga if d.pp_size > 1 else 0.0
+        # Pipeline bubble — executor-dependent (parallel/mpmd.py):
+        # - spmd: the lockstep scan runs n + 2(pp-1) ticks and EVERY tick
+        #   costs a full traced unit on every device (PERF.md r4: idle
+        #   ticks are not free), so bubble = compute * 2(pp-1)/ga.
+        # - mpmd: idle ticks dispatch nothing. What remains is the
+        #   schedule's fill/drain — (pp-1)/ga of compute for 1f1b/gpipe,
+        #   divided by the interleave factor v for the interleaved
+        #   schedule — plus the per-dispatch host cost of walking the
+        #   table (2 programs per microbatch per virtual stage).
+        bubble_s = 0.0
+        if d.pp_size > 1:
+            pl = cfg.pipeline
+            if pl.executor == "spmd":
+                bubble_s = compute_s * 2 * (d.pp_size - 1) / ga
+            else:
+                v = pl.interleave if pl.schedule == "interleaved" else 1
+                bubble_s = (compute_s * (d.pp_size - 1) / (v * ga)
+                            + 2 * ga * d.pp_size * v * c.host_dispatch_s)
 
         # optimizer offload: master + both moments stream host->device and
         # the refreshed values stream back, once per step, sharded like the
@@ -549,6 +573,12 @@ def layout_label(cfg: Config) -> str:
         flags.append("zero1")
     if t.optimizer_offload:
         flags.append("offload")
+    pl = getattr(cfg, "pipeline", None)
+    if pl is not None and pl.executor == "mpmd":
+        tag = "mpmd-" + pl.schedule
+        if pl.schedule == "interleaved":
+            tag += f"-v{pl.interleave}"
+        flags.append(tag)
     return "x".join(bits) + (("+" + "+".join(flags)) if flags else "")
 
 

@@ -1,4 +1,5 @@
-"""Layout search: rank the dp×tp×pp×cp×ep×flags space by predicted time.
+"""Layout search: rank the dp×tp×pp×cp×ep×flags×executor space by
+predicted time.
 
 The cost model prices one layout; the planner enumerates the whole space
 for a chip count, prunes the points that cannot fit in HBM, and ranks the
@@ -37,7 +38,7 @@ from typing import Optional
 from picotron_tpu.analysis.cost_model import (
     CostModel, StepCost, layout_label,
 )
-from picotron_tpu.config import Config, num_params
+from picotron_tpu.config import Config, PipelineConfig, num_params
 
 _DTYPE_BYTES = {"bfloat16": 2, "float16": 2, "float32": 4}
 
@@ -85,6 +86,10 @@ class PlanPoint:
                  f"{str(t.optimizer_offload).lower()}",
                  f"training.gradient_accumulation_steps="
                  f"{t.gradient_accumulation_steps}"]
+        p = self.cfg.pipeline
+        parts += [f"pipeline.executor={p.executor}",
+                  f"pipeline.schedule={p.schedule}",
+                  f"pipeline.interleave={p.interleave}"]
         return "--override " + " ".join(parts)
 
     def as_dict(self) -> dict:
@@ -168,12 +173,34 @@ def _factorizations(n: int, k: int):
                 yield (f,) + rest
 
 
+def _pipeline_options(base: Config, pp: int) -> list[PipelineConfig]:
+    """Executor/schedule candidates for a pp-stage slice of the layout
+    space. pp==1 has nothing to schedule; pp>1 adds the mpmd executor
+    under 1f1b and every interleave depth that divides the per-stage
+    layer slot count (the compile-once constraint Config.validate pins).
+    gpipe is deliberately absent: the cost model prices it identically
+    to 1f1b (same v) and it exists as a debugging twin, not a layout."""
+    opts = [PipelineConfig()]
+    if pp <= 1:
+        return opts
+    opts.append(PipelineConfig(executor="mpmd"))
+    slots = -(-base.model.num_hidden_layers // pp)  # ceil
+    for v in range(2, slots + 1):
+        if slots % v == 0:
+            opts.append(PipelineConfig(executor="mpmd",
+                                       schedule="interleaved",
+                                       interleave=v))
+    return opts
+
+
 def candidate_configs(base: Config, chips: int,
                       *, flags: bool = True) -> list[Config]:
     """Every valid layout of `base` over `chips` devices. Flag knobs
     (sequence_parallel / zero1 / optimizer_offload) toggle only where they
-    can matter (sp needs tp>1, zero1 needs dp>1). Grad accumulation is
-    rederived so the global batch matches the base config's."""
+    can matter (sp needs tp>1, zero1 needs dp>1); pipeline executor and
+    schedule enumerate only where pp > 1 (see _pipeline_options). Grad
+    accumulation is rederived so the global batch matches the base
+    config's."""
     t = base.training
     global_batch = base.global_batch_size
     out = []
@@ -183,26 +210,30 @@ def candidate_configs(base: Config, chips: int,
         sp_opts = (False, True) if (flags and tp > 1) else (False,)
         z_opts = (False, True) if (flags and dp > 1) else (False,)
         o_opts = (False, True) if flags else (False,)
+        pipe_opts = _pipeline_options(base, pp) if flags \
+            else [PipelineConfig()]
         for sp in sp_opts:
             for z1 in z_opts:
                 for off in o_opts:
-                    cfg = base.replace(
-                        distributed=dataclasses.replace(
-                            base.distributed, dp_size=dp, tp_size=tp,
-                            pp_size=pp, cp_size=cp, ep_size=ep,
-                            sequence_parallel=sp, zero1=z1),
-                        training=dataclasses.replace(
-                            t, gradient_accumulation_steps=ga,
-                            optimizer_offload=off,
-                            # offload demands bf16 + 1f1b; grad_engine
-                            # auto lets each layout pick its engine
-                            grad_engine="auto"),
-                    )
-                    try:
-                        cfg.validate()
-                    except (ValueError, KeyError):
-                        continue
-                    out.append(cfg)
+                    for pl in pipe_opts:
+                        cfg = base.replace(
+                            distributed=dataclasses.replace(
+                                base.distributed, dp_size=dp, tp_size=tp,
+                                pp_size=pp, cp_size=cp, ep_size=ep,
+                                sequence_parallel=sp, zero1=z1),
+                            training=dataclasses.replace(
+                                t, gradient_accumulation_steps=ga,
+                                optimizer_offload=off,
+                                # offload demands bf16 + 1f1b; grad_engine
+                                # auto lets each layout pick its engine
+                                grad_engine="auto"),
+                            pipeline=pl,
+                        )
+                        try:
+                            cfg.validate()
+                        except (ValueError, KeyError):
+                            continue
+                        out.append(cfg)
     return out
 
 

@@ -39,10 +39,20 @@ def lower_train_step(cfg, menv=None) -> LoweredStep:
     """Build + lower the config's train step on an abstract mesh. Requires
     enough local (simulated) devices for cfg's world size — the CLI forces
     a host-device count first, exactly like tools/memcheck.py."""
+    import dataclasses
+
+    from picotron_tpu.config import PipelineConfig
     from picotron_tpu.mesh import MeshEnv
     from picotron_tpu.parallel.api import init_sharded_state, make_train_step
 
     cfg.validate()
+    if cfg.pipeline.executor == "mpmd":
+        # The MPMD executor is a host-side schedule walker over per-stage
+        # programs — there is no single jit to lower. Trace-level checks
+        # (collectives, provenance, donation, stability) run on its SPMD
+        # twin: same math, one program. The per-stage compile-once claim
+        # is proven separately by variants.prove_mpmd_stages.
+        cfg = dataclasses.replace(cfg, pipeline=PipelineConfig())
     menv = menv if menv is not None else MeshEnv.from_config(cfg)
     state = init_sharded_state(cfg, menv, jax.random.key(0), abstract=True)
     step = make_train_step(cfg, menv)

@@ -218,6 +218,45 @@ def prove_train_step(cfg, menv=None, *, low=None) -> Report:
 
 
 # ---------------------------------------------------------------------------
+# MPMD stage programs
+# ---------------------------------------------------------------------------
+
+
+def prove_mpmd_stages(cfg, menv=None) -> Report:
+    """Certify every MPMD per-stage program compiles exactly once.
+
+    mpmd.mpmd_entry_feeds enumerates, per stage program (fwd and bwd of
+    each virtual stage), the abstract argument tuple of EVERY call the
+    config's schedule table makes — committed ShapeDtypeStructs with the
+    submesh shardings the executor device_puts. audit_feeds then closes
+    each entry's signature space; a stage whose scheduled calls disagree
+    in abstract signature (a second executable minted mid-schedule) is an
+    ERROR, which shardcheck renders as a fatal row."""
+    from picotron_tpu.mesh import MeshEnv
+    from picotron_tpu.parallel.mpmd import mpmd_entry_feeds
+
+    rep = Report()
+    menv = menv if menv is not None else MeshEnv.from_config(cfg)
+    feeds = mpmd_entry_feeds(cfg, menv)
+    entries = {}
+    proven_all = True
+    for entry in sorted(feeds):
+        sub = audit_feeds(feeds[entry], entry=entry)
+        rep.findings.extend(sub.findings)
+        info = sub.info.get(CHECK, {})
+        entries[entry] = info
+        proven_all = proven_all and bool(info.get("proven"))
+    rep.info[CHECK] = {"entry": "mpmd_stages", "programs": len(feeds),
+                       "proven": proven_all, "entries": entries}
+    if proven_all:
+        rep.add(CHECK, INFO, "mpmd_stages",
+                f"compile-once proven for all {len(feeds)} stage programs "
+                f"(schedule {cfg.pipeline.schedule}, every scheduled call "
+                f"presents one committed abstract signature per program)")
+    return rep
+
+
+# ---------------------------------------------------------------------------
 # Serve programs
 # ---------------------------------------------------------------------------
 
@@ -351,6 +390,12 @@ def audit_variants(cfg, *, low=None, menv=None) -> Report:
     servable (always — the serve programs depend only on ModelConfig)."""
     rep = prove_train_step(cfg, menv, low=low)
     info = {"train_step": rep.info.get(CHECK, {})}
+    if cfg.pipeline.executor == "mpmd":
+        # per-stage programs: the host executor's jits live OUTSIDE the
+        # (twin-lowered) train_step jit, so they need their own proof
+        stage_rep = prove_mpmd_stages(cfg, menv)
+        rep.findings.extend(stage_rep.findings)
+        info["mpmd_stages"] = stage_rep.info.get(CHECK, {})
     try:
         serve_rep = prove_serve_programs(cfg.model)
         rep.findings.extend(serve_rep.findings)

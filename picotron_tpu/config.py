@@ -727,6 +727,48 @@ class LoggingConfig:
 
 
 @dataclass(frozen=True)
+class PipelineConfig:
+    """Pipeline executor selection (picotron_tpu/parallel/mpmd.py).
+
+    executor='spmd' is the reference twin: the whole pipeline is one jitted
+    program over the full mesh and the schedule is a lockstep lax.scan over
+    the 1f1b table — every device runs every tick, so an IDLE tick costs a
+    full traced unit (PERF.md r4 measured the implied bubble at 7.0 ticks
+    for pp=4). executor='mpmd' compiles one program per stage and drives
+    them from the host-side schedule table; idle ticks cost ~0 host time
+    (arxiv 2412.14374), which is what makes interleaved schedules
+    profitable at all (see the PERF.md "Interleaved-PP rejection" note,
+    which is scoped to the SPMD executor)."""
+
+    # 'spmd' (lockstep scan twin, the default) or 'mpmd' (per-stage
+    # programs + host-side schedule).
+    executor: str = "spmd"
+    # Schedule grammar: '1f1b' (one-forward-one-backward, depth-first
+    # backward priority), 'gpipe' (all forwards then all backwards — the
+    # AFAB dependency shape, useful as a debugging twin), 'interleaved'
+    # (virtual stages: each device group owns `interleave` non-contiguous
+    # layer chunks, shrinking the bubble to (pp-1)/v units). mpmd only for
+    # anything but '1f1b'.
+    schedule: str = "1f1b"
+    # Virtual pipeline chunks per device group (v). 1 = plain schedules;
+    # >= 2 requires schedule='interleaved' and executor='mpmd'.
+    interleave: int = 1
+
+    def validate(self) -> None:
+        if self.executor not in ("spmd", "mpmd"):
+            raise ValueError(
+                f"pipeline.executor must be 'spmd' or 'mpmd', got "
+                f"{self.executor!r}")
+        if self.schedule not in ("1f1b", "gpipe", "interleaved"):
+            raise ValueError(
+                f"pipeline.schedule must be '1f1b', 'gpipe', or "
+                f"'interleaved', got {self.schedule!r}")
+        if self.interleave < 1:
+            raise ValueError(
+                f"pipeline.interleave must be >= 1, got {self.interleave}")
+
+
+@dataclass(frozen=True)
 class Config:
     distributed: DistributedConfig = field(default_factory=DistributedConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
@@ -736,6 +778,7 @@ class Config:
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    pipeline: PipelineConfig = field(default_factory=PipelineConfig)
 
     # -- derived quantities (ref: data.py:17-20) --
 
@@ -758,6 +801,7 @@ class Config:
         self.model.validate()
         self.resilience.validate()
         self.serve.validate()
+        self.pipeline.validate()
         if self.serve.max_model_len > self.model.max_position_embeddings:
             raise ValueError(
                 f"serve.max_model_len ({self.serve.max_model_len}) exceeds "
@@ -943,6 +987,60 @@ class Config:
                 f"gradient_accumulation_steps must be >= 1, got "
                 f"{t.gradient_accumulation_steps}"
             )
+        pl = self.pipeline
+        if pl.executor == "spmd":
+            if pl.schedule != "1f1b" or pl.interleave != 1:
+                raise ValueError(
+                    "the spmd executor only runs the lockstep 1f1b scan "
+                    "(schedule='1f1b', interleave=1); alternative schedules "
+                    "require pipeline.executor='mpmd', where an idle tick "
+                    f"stops costing a full traced unit — got "
+                    f"schedule={pl.schedule!r} interleave={pl.interleave}")
+        else:  # mpmd
+            if d.pp_size < 2:
+                raise ValueError(
+                    "pipeline.executor='mpmd' requires pp_size >= 2 (with "
+                    "one stage there is nothing to schedule; the single "
+                    "jitted program IS the spmd executor)")
+            if t.optimizer_offload:
+                raise ValueError(
+                    "pipeline.executor='mpmd' does not compose with "
+                    "training.optimizer_offload yet (the streamed host "
+                    "update assumes the monolithic step program); use the "
+                    "spmd executor for offload runs")
+            if m.num_experts:
+                raise ValueError(
+                    "pipeline.executor='mpmd' does not support MoE models "
+                    "yet (per-stage submeshes drop the 'ep' axis from the "
+                    "stage programs); use the spmd executor")
+            if d.sequence_parallel:
+                raise ValueError(
+                    "pipeline.executor='mpmd' does not support "
+                    "sequence_parallel yet (the sp grad sync runs over the "
+                    "whole-mesh program); use the spmd executor")
+            if d.pp_engine != "1f1b":
+                raise ValueError(
+                    "pipeline.executor='mpmd' drives the host schedule "
+                    "table; set pp_engine='1f1b' (the afab engine is an "
+                    "spmd-only differentiation strategy)")
+        if pl.schedule == "interleaved":
+            if pl.interleave < 2:
+                raise ValueError(
+                    "pipeline.schedule='interleaved' needs interleave >= 2 "
+                    "(v=1 interleaving IS plain 1f1b); got "
+                    f"{pl.interleave}")
+            slots = -(-m.num_hidden_layers // d.pp_size)  # ceil
+            if slots % pl.interleave != 0:
+                raise ValueError(
+                    f"pipeline.interleave ({pl.interleave}) must divide the "
+                    f"per-stage layer slot count (ceil(num_hidden_layers / "
+                    f"pp_size) = {slots}) so every virtual chunk is the "
+                    f"same shape and compiles once")
+        elif pl.interleave != 1:
+            raise ValueError(
+                f"pipeline.interleave > 1 requires "
+                f"pipeline.schedule='interleaved', got "
+                f"schedule={pl.schedule!r} interleave={pl.interleave}")
 
     def to_json_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -1004,6 +1102,7 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
         logging=LoggingConfig(**_filter_kwargs(LoggingConfig, raw.get("logging", {}))),
         resilience=ResilienceConfig(**_filter_kwargs(ResilienceConfig, raw.get("resilience", {}))),
         serve=ServeConfig(**_filter_kwargs(ServeConfig, raw.get("serve", {}))),
+        pipeline=PipelineConfig(**_filter_kwargs(PipelineConfig, raw.get("pipeline", {}))),
     )
     cfg.validate()
     return cfg

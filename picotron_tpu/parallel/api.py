@@ -406,6 +406,14 @@ def make_train_step(cfg: Config, menv: MeshEnv, inject_nan: bool = False):
     step: it is the only way the in-jit skip path sees a genuinely
     non-finite gradient tree."""
     cfg.validate()
+    if cfg.pipeline.executor == "mpmd":
+        # Per-stage programs + host-side schedule (parallel/mpmd.py) —
+        # same (state, batch) -> (state, metrics) contract, so callers
+        # (train.py, chaos harness) never see the executor swap. Lazy
+        # import: mpmd.py imports this module at its top level.
+        from picotron_tpu.parallel.mpmd import make_mpmd_train_step
+
+        return make_mpmd_train_step(cfg, menv, inject_nan=inject_nan)
     mesh = menv.mesh
     pspecs = param_specs(cfg)
     bspec = batch_spec()

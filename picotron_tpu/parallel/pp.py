@@ -41,7 +41,8 @@ scan carry; live boundary inputs sit in a min(n_micro, 2(pp-1))-slot ring,
 default engine: ~AFAB speed with O(pp) instead of O(n_micro) boundary-
 activation memory.
 
-**Why no Megatron interleaved (virtual-stage) schedule**: with v chunks per
+**Why no Megatron interleaved (virtual-stage) schedule UNDER THIS
+EXECUTOR** (`pipeline.executor: spmd`, the default): with v chunks per
 device the pipeline deepens to V = v*pp virtual stages, and in a
 masked-uniform SPMD tick model every tick must trace each device's v
 forward + v backward units whether active or not — so fill/drain cost
@@ -49,10 +50,17 @@ grows with V while per-tick cost grows with v, making interleaving
 STRICTLY worse here (efficiency n/(n + 2(V-1)) vs this schedule's
 n/(n + 2(pp-1))). Interleaving wins on per-rank imperative runtimes
 because idle warmup slots cost nothing; under jit they cost a full traced
-unit. Gating the units with lax.cond (the head-scoring trick) cannot
-recover it either: a skipped unit still occupies its tick slot in the
-schedule. The right lever for bubble fraction on TPU is more microbatches
-(n), which this full-rate schedule already amortizes at 2(pp-1)/n.
+unit (PERF.md r4 measured ~one traced unit per idle tick). Gating the
+units with lax.cond (the head-scoring trick) cannot recover it either: a
+skipped unit still occupies its tick slot in the schedule. Under the scan
+the lever for bubble fraction is more microbatches (n), amortized at
+2(pp-1)/n.
+
+`pipeline.executor: mpmd` (parallel/mpmd.py) is the executor where that
+premise does not hold: per-stage programs driven by a host-side schedule
+table make idle ticks ~free, so the interleaved schedule is supported
+there (and measured winning, PERF.md r10). This module stays the SPMD
+reference twin the MPMD executor is parity-pinned against.
 """
 
 from __future__ import annotations

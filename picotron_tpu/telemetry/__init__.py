@@ -89,8 +89,13 @@ class Telemetry:
         self.compile_watch = (compile_watch if compile_watch is not None
                               else CompileWatch().install())
         self.phases = PhaseTimer(self._phase_done, watchdog=watchdog,
-                                 on_enter=self._phase_enter)
+                                 on_enter=self._phase_enter,
+                                 on_section=self._section_done)
         self._step_phases_done = 0
+        # Analytic pipeline-bubble share of each step phase (from the
+        # schedule table, parallel/mpmd.pipeline_bubble_fraction) —
+        # installed by the driver once per run, 0.0 when pp is off.
+        self.pp_bubble_fraction = 0.0
         self._closed = False
         # Anchor the stream's wall-clock: compiles/setup before the first
         # phase would otherwise make the report's `accounted` exceed its
@@ -112,6 +117,13 @@ class Telemetry:
 
     def attach_watchdog(self, watchdog) -> None:
         self.phases.watchdog = watchdog
+
+    def set_pp_bubble_fraction(self, fraction: float) -> None:
+        """Install the analytic pipeline-bubble share (schedule-table
+        fraction of each step's wall spent in fill/drain idle). Every
+        subsequent step phase carves this share of its compute into the
+        `pp_bubble` ledger category."""
+        self.pp_bubble_fraction = min(max(float(fraction), 0.0), 1.0)
 
     def attach_wandb(self, run) -> "WandbSink":
         sink = WandbSink(run)
@@ -170,8 +182,15 @@ class Telemetry:
         (category, secs) pairs over the JSONL reproduces the ledger."""
         n_compiles, compile_secs = self.compile_watch.drain()
         compile_secs = min(compile_secs, max(secs, 0.0))
+        bubble_secs = 0.0
+        if name == "step" and self.pp_bubble_fraction > 0.0:
+            bubble_secs = self.pp_bubble_fraction * max(
+                secs - compile_secs, 0.0)
         category = self.ledger.book_phase(name, secs, step=step,
-                                          compile_secs=compile_secs)
+                                          compile_secs=compile_secs,
+                                          bubble_secs=bubble_secs)
+        if category != "compute":
+            bubble_secs = 0.0  # ledger carves compute only (replay etc.)
         self.registry.histogram(f"phase/{name}").observe(secs)
         if n_compiles:
             self.registry.counter("compile/count").inc(n_compiles)
@@ -187,8 +206,26 @@ class Telemetry:
                           compile_secs=round(compile_secs, 6))
         if name == "step":
             self._step_phases_done += 1
-        self.emit("phase", category=category, secs=secs - compile_secs,
+        if bubble_secs > 0.0:
+            # the bubble share rides its own category="pp_bubble" event
+            # (like compile) so the JSONL (category, secs) sum still
+            # reproduces the ledger exactly
+            self.emit("pp_bubble", category="pp_bubble", secs=bubble_secs,
+                      book=False, phase=name, step=step)
+        self.emit("phase", category=category,
+                  secs=secs - compile_secs - bubble_secs,
                   book=False, phase=name, step=step)
+
+    def _section_done(self, name: str, secs: float, step) -> None:
+        """PhaseTimer section callback: histogram only (see
+        PhaseTimer.section for why sections never touch the ledger)."""
+        self.registry.histogram(f"section/{name}").observe(secs)
+
+    def observe_section(self, name: str, secs: float) -> None:
+        """Record an externally-measured section duration (e.g. the MPMD
+        executor's per-stage tick times, timed inside the schedule walker
+        where a context manager cannot reach)."""
+        self.registry.histogram(f"section/{name}").observe(secs)
 
     # -- step / eval records ----------------------------------------------
 

@@ -19,6 +19,12 @@ Every timed second of the run is booked to exactly one category:
 - ``data_wait``    — the step loop blocked on the data producer (covers
                      injected/real data stalls).
 - ``host_sync``    — device->host metric fetch for guards/logging.
+- ``pp_bubble``    — the pipeline-parallel bubble share of the step
+                     phase: fill/drain ticks where stages sit idle.
+                     Analytic (schedule-table fraction from
+                     parallel/mpmd.py, both executors), carved out of
+                     the step's compute so goodput%% reflects that a
+                     pp run's devices are not busy wall-to-wall.
 - ``eval``         — validation passes.
 - ``other``        — anything booked without a better class.
 - ``prefill`` / ``decode`` / ``queue_wait`` — serving streams only
@@ -59,7 +65,8 @@ PHASE_CATEGORY = {
 
 CATEGORIES = (
     "compute", "compile", "replay", "restore", "ckpt_io", "preempt",
-    "retry_backoff", "data_wait", "host_sync", "eval", "other",
+    "retry_backoff", "data_wait", "host_sync", "pp_bubble", "eval",
+    "other",
     # serving (picotron_tpu/serve): device time in the two jitted
     # programs (goodput) and the admission-latency badput
     "prefill", "decode", "queue_wait",
@@ -81,13 +88,18 @@ class GoodputLedger:
         self.seconds[category] = self.seconds.get(category, 0.0) + secs
 
     def book_phase(self, phase: str, secs: float, step=None,
-                   compile_secs: float = 0.0) -> str:
+                   compile_secs: float = 0.0,
+                   bubble_secs: float = 0.0) -> str:
         """Book one completed phase; returns the category the NON-compile
         remainder was booked under (what the phase event should carry).
         `compile_secs` is the exactly-measured XLA compile time that
         occurred inside this phase (recompile.CompileWatch) — booked as
         `compile` and subtracted, so step 1's wall does not masquerade as
-        productive compute."""
+        productive compute. `bubble_secs` is the pipeline-bubble share of
+        a step phase (fraction × step wall, from the schedule table) —
+        carved out of `compute` into `pp_bubble` so a pp run's goodput%%
+        reflects the fill/drain idle time. Only compute is carved:
+        a replayed step is already badput wall-to-wall."""
         compile_secs = min(max(compile_secs, 0.0), max(secs, 0.0))
         if compile_secs:
             self.book("compile", compile_secs)
@@ -98,6 +110,11 @@ class GoodputLedger:
                 category = "replay"
             else:
                 self.high_water_step = step
+        if category == "compute":
+            bubble_secs = min(max(bubble_secs, 0.0), max(secs, 0.0))
+            if bubble_secs:
+                self.book("pp_bubble", bubble_secs)
+                secs -= bubble_secs
         self.book(category, secs)
         return category
 

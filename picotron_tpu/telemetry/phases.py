@@ -21,9 +21,12 @@ class PhaseTimer:
     def __init__(self, on_phase: Callable[[str, float, Optional[int]], None],
                  watchdog=None,
                  on_enter: Optional[Callable[[str, Optional[int]], None]]
-                 = None):
+                 = None,
+                 on_section: Optional[
+                     Callable[[str, float, Optional[int]], None]] = None):
         self._on_phase = on_phase
         self._on_enter = on_enter
+        self._on_section = on_section
         self.watchdog = watchdog
 
     @contextmanager
@@ -44,3 +47,17 @@ class PhaseTimer:
             yield
         finally:
             self._on_phase(name, time.perf_counter() - t0, step)
+
+    @contextmanager
+    def section(self, name: str, step: Optional[int] = None):
+        """Time a sub-span INSIDE a phase (a pipeline stage's ticks, a
+        loss post-process). Sections feed the histogram registry only:
+        no watchdog beat (the enclosing phase already armed it) and no
+        ledger booking (their wall is part of the enclosing phase — a
+        second booking would double-count the same seconds)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if self._on_section is not None:
+                self._on_section(name, time.perf_counter() - t0, step)

@@ -243,6 +243,30 @@ def main(argv=None) -> None:
                     f"{cfg.distributed.world_size} chips "
                     f"({best.label}, {best.cost.total_s * 1e3:.4g} "
                     f"ms/step). To adopt it: {best.overrides_line()}")
+                if (cfg.pipeline.executor == "spmd"
+                        and cfg.distributed.pp_size > 1):
+                    # When just flipping the executor (same layout)
+                    # closes a material share of the gap, say so — it is
+                    # a one-knob change, vs the full relayout above.
+                    import dataclasses as _dc
+
+                    from picotron_tpu.config import PipelineConfig
+
+                    try:
+                        twin = _dc.replace(
+                            cfg, pipeline=PipelineConfig(executor="mpmd"))
+                        twin.validate()
+                        closed = cur.total_s - cm.predict(twin).total_s
+                        gap_s = cur.total_s - best.cost.total_s
+                        if gap_s > 0 and closed >= 0.2 * gap_s:
+                            log_print(
+                                f"cost preflight: pipeline.executor=mpmd "
+                                f"alone (same layout) is predicted to "
+                                f"close {closed / gap_s * 100:.0f}% of "
+                                f"that gap — --override "
+                                f"pipeline.executor=mpmd")
+                    except (ValueError, KeyError):
+                        pass  # layout can't host mpmd (offload/sp/MoE)
 
     n_chips = menv.world_size
     n_params = num_params(cfg.model)
@@ -265,6 +289,26 @@ def main(argv=None) -> None:
     tel = telemetry_bus.install(Telemetry.from_config(cfg))
     if tel.jsonl_path:
         log_print(f"telemetry -> {tel.jsonl_path}")
+    if cfg.distributed.pp_size > 1:
+        # Book the analytic fill/drain share of every step into the
+        # pp_bubble ledger category (both executors — the schedule table
+        # implies the fraction either way), and let the MPMD executor's
+        # sampled per-stage tick timings (PICOTRON_PP_TICK_SAMPLE) feed
+        # the section/pp_stage* histograms the telemetry report reads.
+        from picotron_tpu.parallel import mpmd
+
+        tel.set_pp_bubble_fraction(mpmd.pipeline_bubble_fraction(cfg))
+        log_print(f"pipeline: executor={cfg.pipeline.executor} "
+                  f"schedule={cfg.pipeline.schedule} "
+                  f"v={cfg.pipeline.interleave} — predicted bubble "
+                  f"{tel.pp_bubble_fraction * 100:.1f}% of step wall")
+        if cfg.pipeline.executor == "mpmd":
+            def _stage_times(timings, _step, _tel=tel):
+                for g, secs in sorted(timings.items()):
+                    for s in secs:
+                        _tel.observe_section(f"pp_stage{g}", s)
+
+            mpmd.on_stage_times = _stage_times
 
     dl = MicroBatchDataLoader(cfg, menv)
     (state, start_step, trained_tokens, ckpt_meta,

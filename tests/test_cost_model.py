@@ -181,8 +181,9 @@ def test_predict_decomposition_consistency():
     cost = CostModel("v5e").predict(cfg)
     assert cost.n_chips == 8
     assert cost.compute_s > 0
-    # 1f1b bubble: compute * (pp-1)/ga
-    assert cost.bubble_s == pytest.approx(cost.compute_s * 1 / 4)
+    # spmd lockstep-scan bubble: compute * 2*(pp-1)/ga (full-price idle
+    # ticks — the mpmd executor halves this, test below)
+    assert cost.bubble_s == pytest.approx(cost.compute_s * 2 / 4)
     assert cost.total_s >= cost.compute_s + cost.bubble_s
     assert cost.exposed_comm_s <= cost.comm_s
     names = {t.name for t in cost.comm}
@@ -191,6 +192,40 @@ def test_predict_decomposition_consistency():
     d = cost.as_dict()
     assert d["predicted_step_ms"] == pytest.approx(cost.total_s * 1e3,
                                                    abs=5e-4)  # ms rounding
+
+
+def test_predict_mpmd_bubble_and_label():
+    """The executor knob changes only the bubble term: mpmd halves the
+    spmd fill/drain (and divides by v under interleaving) but pays a
+    host-dispatch charge per scheduled program — at tiny compute the
+    dispatch dominates, at scale the halved bubble wins."""
+    import dataclasses
+
+    from picotron_tpu.analysis.cost_model import layout_label
+    from picotron_tpu.config import PipelineConfig
+
+    base = mkcfg(dist=dict(dp_size=2, tp_size=2, pp_size=2), ga=4)
+    cm = CostModel("v5e")
+    spmd = cm.predict(base)
+    for pl, v in [(PipelineConfig(executor="mpmd"), 1),
+                  (PipelineConfig(executor="mpmd", schedule="interleaved",
+                                  interleave=2), 2)]:
+        cfg = dataclasses.replace(base, pipeline=pl)
+        cfg.validate()
+        cost = cm.predict(cfg)
+        assert cost.compute_s == pytest.approx(spmd.compute_s)
+        dispatch = 2 * 4 * 2 * v * cm.calib.host_dispatch_s
+        assert cost.bubble_s == pytest.approx(
+            cost.compute_s * 1 / (v * 4) + dispatch)
+        assert "mpmd" in layout_label(cfg)
+    assert "v2" in layout_label(
+        dataclasses.replace(base, pipeline=PipelineConfig(
+            executor="mpmd", schedule="interleaved", interleave=2)))
+    # the dispatch term scales with ga*pp*v; zeroing it makes mpmd's
+    # bubble strictly half of spmd's at v=1
+    free = with_calibration(cm, host_dispatch_s=0.0)
+    cfg = dataclasses.replace(base, pipeline=PipelineConfig(executor="mpmd"))
+    assert free.predict(cfg).bubble_s == pytest.approx(spmd.bubble_s / 2)
 
 
 def test_predict_prices_every_promised_axis():

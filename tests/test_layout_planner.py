@@ -75,6 +75,47 @@ def test_invalid_layouts_are_skipped():
     assert all(c.distributed.pp_size <= 4 for c in cands)
 
 
+def test_plan_enumerates_mpmd_and_overrides_round_trip():
+    """Every pp>1 layout is priced under both executors (plus interleaved
+    variants where v divides the per-group layer slots), and an mpmd plan
+    point's --override line survives the tools/memcheck.py override
+    mechanism: dotted paths into the raw JSON, bare strings for
+    string-typed fields like pipeline.executor."""
+    from picotron_tpu.config import config_from_dict
+
+    pts = plan(tiny_base(), 8, CostModel("v5e"))
+    mpmd_pts = [p for p in pts if "mpmd" in p.label]
+    assert mpmd_pts, [p.label for p in pts]
+    assert any("interleaved" in p.label for p in mpmd_pts)
+    assert any("mpmd-1f1b" in p.label for p in mpmd_pts)
+
+    point = next(p for p in mpmd_pts if "interleaved" in p.label)
+    line = point.overrides_line()
+    assert "pipeline.executor=mpmd" in line
+    assert "pipeline.schedule=interleaved" in line
+
+    # the memcheck --override application: JSON values where they parse,
+    # bare strings otherwise (legitimate only for string-typed fields)
+    raw = {"model": {"name": "debug-tiny"},
+           "training": {"seq_length": 64, "micro_batch_size": 1,
+                        "gradient_accumulation_steps": 8}}
+    for ov in line.split()[1:]:
+        dotted, _, val = ov.partition("=")
+        node = raw
+        *path, key = dotted.split(".")
+        for part in path:
+            node = node.setdefault(part, {})
+        try:
+            node[key] = json.loads(val)
+        except ValueError:
+            node[key] = val
+    cfg = config_from_dict(raw)  # validates
+    assert cfg.pipeline.executor == "mpmd"
+    assert cfg.pipeline.schedule == "interleaved"
+    assert cfg.pipeline.interleave >= 2
+    assert cfg.distributed.pp_size == point.cfg.distributed.pp_size
+
+
 def test_plan_ranks_and_is_deterministic():
     base = tiny_base()
     model = CostModel("v5e")

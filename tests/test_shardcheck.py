@@ -25,13 +25,17 @@ from picotron_tpu.config import (
 )
 
 
-def mkcfg(model="debug-tiny", seq=64, mbs=1, ga=1, dist=None, train=None):
+def mkcfg(model="debug-tiny", seq=64, mbs=1, ga=1, dist=None, train=None,
+          pipe=None):
+    from picotron_tpu.config import PipelineConfig
+
     cfg = Config(
         distributed=DistributedConfig(**(dist or {})),
         model=ModelConfig(name=model, **resolve_preset(model)),
         training=TrainingConfig(seq_length=seq, micro_batch_size=mbs,
                                 gradient_accumulation_steps=ga,
                                 **(train or {})),
+        pipeline=PipelineConfig(**(pipe or {})),
     )
     cfg.validate()
     return cfg
@@ -45,6 +49,10 @@ MATRIX = {
     "dense-dp2tp2cp2": dict(dist=dict(dp_size=2, tp_size=2, cp_size=2),
                             ga=2),
     "dense-pp2dp2": dict(dist=dict(pp_size=2, dp_size=2), ga=2),
+    # mpmd executor: the audit runs on the SPMD twin lowering; the
+    # per-stage programs get their own prover (test_analysis_mpmd)
+    "dense-pp2dp2-mpmd": dict(dist=dict(pp_size=2, dp_size=2), ga=2,
+                              pipe=dict(executor="mpmd")),
     "moe-ep2dp2": dict(model="debug-tiny-moe",
                        dist=dict(ep_size=2, dp_size=2), ga=2),
     "dense-offload": dict(ga=2, train=dict(optimizer_offload=True)),
@@ -601,8 +609,8 @@ def test_shardflow_runs_gate():
         if prov["attribution_pct"] < 90.0:
             problems.append(f"{name}: attribution "
                             f"{prov['attribution_pct']}% < 90%")
-        for entry in ("train_step", "serve"):
-            if (base[f"{entry}_proven"]
+        for entry in ("train_step", "serve", "mpmd_stages"):
+            if (base.get(f"{entry}_proven")
                     and not var.get(entry, {}).get("proven")):
                 problems.append(f"{name}: {entry} no longer proven "
                                 f"compile-once")

@@ -252,6 +252,88 @@ def test_ledger_compile_split_subtracts_from_phase():
     assert led2.seconds.get("compute", 0.0) == 0.0
 
 
+def test_ledger_pp_bubble_carved_from_compute():
+    """The schedule-table bubble share is badput carved out of a compute
+    phase (like compile); a replayed step is already badput wall-to-wall,
+    so its bubble share is NOT double-carved."""
+    led = GoodputLedger()
+    cat = led.book_phase("step", 10.0, step=1, compile_secs=2.0,
+                         bubble_secs=3.0)
+    assert cat == "compute"
+    assert led.seconds["compile"] == 2.0
+    assert led.seconds["pp_bubble"] == 3.0
+    assert led.seconds["compute"] == pytest.approx(5.0)
+    # replay: the whole phase books as replay, bubble untouched
+    led2 = GoodputLedger()
+    led2.resume_from(5)
+    assert led2.book_phase("step", 4.0, step=5, bubble_secs=1.0) == "replay"
+    assert led2.seconds.get("pp_bubble", 0.0) == 0.0
+    assert led2.seconds["replay"] == 4.0
+    # the carve clamps to the phase wall
+    led3 = GoodputLedger()
+    led3.book_phase("step", 1.0, step=1, bubble_secs=9.0)
+    assert led3.seconds["pp_bubble"] == 1.0
+    assert led3.seconds.get("compute", 0.0) == 0.0
+
+
+def test_phase_timer_section_histogram_only():
+    """Sections time sub-spans inside a phase: on_section fires with the
+    duration, but no watchdog beat (the enclosing phase armed it) and no
+    phase booking."""
+    phases, sections = [], []
+    wd = FakeWatchdog()
+    timer = PhaseTimer(lambda n, s, st: phases.append(n), watchdog=wd,
+                       on_section=lambda n, s, st: sections.append((n, st)))
+    with timer.phase("step", 7):
+        with timer.section("pp_stage0", 7):
+            pass
+        with timer.section("pp_stage1", 7):
+            pass
+    assert [s[0] for s in sections] == ["pp_stage0", "pp_stage1"]
+    assert phases == ["step"]
+    assert wd.beats == [("step", 7)]  # sections never beat
+
+
+def test_facade_pp_bubble_jsonl_reproduces_ledger(tmp_path):
+    """With a bubble fraction installed, every step phase emits a
+    category='pp_bubble' event next to the shrunken phase event — the
+    documented invariant (a post-hoc sum of (category, secs) pairs
+    reproduces the ledger) must survive the carve."""
+    p = str(tmp_path / "t.jsonl")
+    tel = Telemetry(sinks=[JsonlSink(p)])
+    tel.set_pp_bubble_fraction(0.25)
+    with tel.phases.phase("step", 1):
+        pass
+    with tel.phases.phase("data", 1):
+        pass  # non-step phases never carve
+    tel.close()
+    rows = [json.loads(ln) for ln in open(p)]
+    bubbles = [r for r in rows if r["kind"] == "pp_bubble"]
+    assert len(bubbles) == 1 and bubbles[0]["category"] == "pp_bubble"
+    sums: dict = {}
+    for r in rows:
+        if "category" in r and "secs" in r:
+            sums[r["category"]] = sums.get(r["category"], 0.0) + r["secs"]
+    for cat, secs in tel.ledger.seconds.items():
+        assert sums.get(cat, 0.0) == pytest.approx(secs, abs=1e-5), (
+            cat, sums, tel.ledger.seconds)
+    assert tel.ledger.seconds["pp_bubble"] == pytest.approx(
+        0.25 * (tel.ledger.seconds["pp_bubble"]
+                + tel.ledger.seconds["compute"]), rel=1e-6)
+
+
+def test_facade_observe_section_feeds_stage_histograms():
+    tel = Telemetry(sinks=[])
+    try:
+        for secs in (0.01, 0.02, 0.03):
+            tel.observe_section("pp_stage0", secs)
+        snap = tel.registry.snapshot()["histograms"]["section/pp_stage0"]
+        assert snap["count"] == 3
+        assert snap["p50"] == pytest.approx(0.02)
+    finally:
+        tel.close()
+
+
 def test_ledger_unknown_category_books_as_other():
     led = GoodputLedger()
     led.book("???", 1.0)
@@ -493,6 +575,36 @@ def test_report_render_text_and_markdown(tmp_path):
     assert "| category | seconds | share |" in md
     assert "| compute | 3.000 | 75.0% |" in md
     assert "chaos=1" in md
+
+
+def test_report_pipeline_row(tmp_path):
+    """A pp run's stream: pp_bubble events + per-stage section histograms
+    in the run_summary must surface as the pipeline row (bubble share of
+    step wall, per-stage tick p50/p95)."""
+    rep = load_report()
+    p = tmp_path / "telemetry.jsonl"
+    _write_events(p, [
+        {"ts": 1.0, "kind": "phase", "phase": "step", "step": 1,
+         "category": "compute", "secs": 3.0},
+        {"ts": 1.5, "kind": "pp_bubble", "phase": "step", "step": 1,
+         "category": "pp_bubble", "secs": 1.0},
+        {"ts": 2.0, "kind": "run_summary", "goodput": {},
+         "metrics": {"histograms": {
+             "section/pp_stage0": {"count": 8, "p50": 0.010, "p95": 0.012},
+             "section/pp_stage1": {"count": 8, "p50": 0.011, "p95": 0.014},
+             "phase/step": {"count": 1, "p50": 4.0, "p95": 4.0},
+         }}},
+    ])
+    s = rep.summarize(rep.load_events(str(p)))
+    pp = s["pipeline"]
+    assert pp["bubble_s"] == 1.0
+    assert pp["bubble_fraction"] == pytest.approx(0.25)
+    assert pp["stages"]["pp_stage0"]["p50_ms"] == 10.0
+    assert pp["stages"]["pp_stage1"]["p95_ms"] == 14.0
+    assert "phase/step" not in pp.get("stages", {})
+    text = rep.render(s)
+    assert "bubble 25.0% of step wall" in text
+    assert "pp_stage1" in text
 
 
 def test_report_tolerates_torn_tail_line(tmp_path):

@@ -2,10 +2,12 @@
 """Which layout is fastest? — rank 4D layouts by predicted time, on CPU.
 
 Enumerates the dp×tp×pp×cp×ep×{sequence_parallel, zero1, offload} space
-for a model + chip count, prunes HBM non-fits, prices the survivors with
-the ICI-topology cost model (picotron_tpu/analysis/cost_model.py), and
-prints a ranked table with the predicted-fastest config as a ready-to-run
-overrides line. No TPU needed — the model is calibrated against the
+— and, wherever pp > 1, the pipeline executor/schedule space on top
+({spmd-1f1b, mpmd-1f1b, mpmd-interleaved-vN}) — for a model + chip
+count, prunes HBM non-fits, prices the survivors with the ICI-topology
+cost model (picotron_tpu/analysis/cost_model.py), and prints a ranked
+table with the predicted-fastest config as a ready-to-run overrides
+line. No TPU needed — the model is calibrated against the
 measured SWEEP/BENCH rows on disk (validate with --validate-sweep).
 
   python tools/layout_planner.py --chips 8 --model SmolLM-1.7B --seq 2048

@@ -49,9 +49,9 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-# (model, distributed kwargs, training kwargs) triples; every preset fits
-# the 8 simulated host devices the test tier provisions.
-PRESETS: dict[str, tuple[str, dict, dict]] = {
+# (model, distributed kwargs, training kwargs[, pipeline kwargs]) tuples;
+# every preset fits the 8 simulated host devices the test tier provisions.
+PRESETS: dict[str, tuple] = {
     "tiny-1chip": ("debug-tiny", {}, {}),
     "tiny-dense": ("debug-tiny",
                    dict(dp_size=2, tp_size=2, cp_size=2),
@@ -59,6 +59,13 @@ PRESETS: dict[str, tuple[str, dict, dict]] = {
     "tiny-dense-pp": ("debug-tiny",
                       dict(pp_size=2, dp_size=2),
                       dict(gradient_accumulation_steps=2)),
+    # the MPMD executor's per-stage programs (parallel/mpmd.py): the
+    # --variants prover must certify each stage fwd/bwd jit compiles
+    # exactly once across every call the schedule table makes
+    "tiny-dense-pp-mpmd": ("debug-tiny",
+                           dict(pp_size=2, dp_size=2),
+                           dict(gradient_accumulation_steps=2),
+                           dict(executor="mpmd")),
     "tiny-moe-ep": ("debug-tiny-moe",
                     dict(ep_size=2, dp_size=2),
                     dict(gradient_accumulation_steps=2)),
@@ -86,16 +93,18 @@ PRESETS: dict[str, tuple[str, dict, dict]] = {
 
 def preset_config(name: str):
     from picotron_tpu.config import (
-        Config, DistributedConfig, ModelConfig, TrainingConfig,
-        resolve_preset,
+        Config, DistributedConfig, ModelConfig, PipelineConfig,
+        TrainingConfig, resolve_preset,
     )
 
-    model, dist_kw, train_kw = PRESETS[name]
+    model, dist_kw, train_kw, *rest = PRESETS[name]
+    pipe_kw = rest[0] if rest else {}
     cfg = Config(
         distributed=DistributedConfig(**dist_kw),
         model=ModelConfig(name=model, **resolve_preset(model)),
         training=TrainingConfig(seq_length=64, micro_batch_size=1,
                                 **train_kw),
+        pipeline=PipelineConfig(**pipe_kw),
     )
     cfg.validate()
     return cfg
@@ -250,14 +259,17 @@ def main(argv=None) -> int:
                               flush=True)
             var = rep.info.get("variants")
             if var:
-                for entry in ("train_step", "serve"):
+                for entry in ("train_step", "mpmd_stages", "serve"):
                     v = var.get(entry) or {}
                     if "proven" in v:
                         state = ("proven compile-once" if v["proven"]
                                  else "NOT proven")
+                        detail = (f"{v['programs']} stage program(s)"
+                                  if "programs" in v else
+                                  f"{v.get('signatures', '?')} abstract "
+                                  f"signature(s)")
                         print(f"variants[{v.get('entry', entry)}]: {state} "
-                              f"({v.get('signatures', '?')} abstract "
-                              f"signature(s))", flush=True)
+                              f"({detail})", flush=True)
             if cost_row:
                 line = (f"cost[{cost_row['generation']}]: predicted step "
                         f"{cost_row['predicted_step_ms']} ms (exposed "

@@ -84,6 +84,7 @@ def summarize(events: list[dict]) -> dict:
     eval_rows: list[dict] = []
     serve_reqs: list[dict] = []
     serve_summary: dict | None = None
+    run_summary: dict | None = None
     ts = [e["ts"] for e in events if isinstance(e.get("ts"), (int, float))]
 
     for e in events:
@@ -113,6 +114,8 @@ def summarize(events: list[dict]) -> dict:
             serve_reqs.append(e)
         elif kind == "serve_summary":
             serve_summary = e  # last wins (one per engine run)
+        elif kind == "run_summary":
+            run_summary = e  # last wins (one per process lifetime)
 
     accounted = sum(categories.values())
     goodput = sum(categories.get(c, 0.0) for c in GOODPUT_CATEGORIES)
@@ -162,7 +165,44 @@ def summarize(events: list[dict]) -> dict:
         out["training"]["final_val_loss"] = eval_rows[-1].get("val_loss")
     if serve_reqs or serve_summary:
         out["serving"] = serving_view(serve_reqs, serve_summary)
+    pp = pipeline_view(categories, run_summary)
+    if pp:
+        out["pipeline"] = pp
     return out
+
+
+def pipeline_view(categories: dict[str, float],
+                  run_summary: dict | None) -> dict:
+    """Pipeline-parallel row: the bubble's share of step wall (the
+    pp_bubble category next to the compute/replay it was carved from)
+    plus per-stage tick-time percentiles from the run_summary's
+    section/pp_stage* histograms (fed by the MPMD executor's sampled
+    per-stage timings). Empty dict when the run had no pipeline."""
+    view: dict = {}
+    bubble = categories.get("pp_bubble", 0.0)
+    if bubble > 0.0:
+        step_wall = (bubble + categories.get("compute", 0.0)
+                     + categories.get("replay", 0.0))
+        view["bubble_s"] = round(bubble, 4)
+        view["bubble_fraction"] = round(bubble / step_wall, 4) \
+            if step_wall > 0 else None
+    hists = ((run_summary or {}).get("metrics") or {}).get("histograms",
+                                                           {})
+    stages = {}
+    for name, h in sorted(hists.items()):
+        if not name.startswith("section/pp_stage"):
+            continue
+        stage = name[len("section/"):]
+        stages[stage] = {
+            "count": h.get("count"),
+            "p50_ms": (round(h["p50"] * 1e3, 3)
+                       if isinstance(h.get("p50"), (int, float)) else None),
+            "p95_ms": (round(h["p95"] * 1e3, 3)
+                       if isinstance(h.get("p95"), (int, float)) else None),
+        }
+    if stages:
+        view["stages"] = stages
+    return view
 
 
 def serving_view(reqs: list[dict], summary: dict | None) -> dict:
@@ -286,6 +326,19 @@ def render(s: dict, markdown: bool = False) -> str:
                f"measured sync p50 {cm['measured_sync_p50_ms']} ms"
                + (f" | drift {drift:+.1f}%" if drift is not None else ""))
         lines.append(f"**{msg}**" if markdown else msg)
+        lines.append("")
+    pp = s.get("pipeline")
+    if pp:
+        frac = pp.get("bubble_fraction")
+        msg = "pipeline:"
+        if frac is not None:
+            msg += (f" bubble {100.0 * frac:.1f}% of step wall "
+                    f"({pp['bubble_s']:.3f}s)")
+        lines.append(f"**{msg}**" if markdown else msg)
+        for stage, st in pp.get("stages", {}).items():
+            lines.append(
+                f"  {stage:14s} x{st['count'] or 0:<6d} tick p50 "
+                f"{st['p50_ms']} ms  p95 {st['p95_ms']} ms")
         lines.append("")
     sv = s.get("serving")
     if sv:
