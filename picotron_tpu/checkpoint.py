@@ -42,7 +42,7 @@ from picotron_tpu.ckpt_integrity import (
     rmtree, verify_step_dir, write_manifest,
 )
 from picotron_tpu.config import Config, ModelConfig
-from picotron_tpu.resilience import chaos
+from picotron_tpu.resilience import chaos, elastic
 from picotron_tpu.resilience.retry import RetryPolicy, retry_call
 from picotron_tpu.telemetry import bus as telemetry_bus
 from picotron_tpu.train_step import TrainState
@@ -411,6 +411,19 @@ class CheckpointManager:
 
         meta = retry_call(_read_meta, policy=self._retry,
                           describe=f"checkpoint meta read (step {step})")
+        # Topology compatibility (resilience/elastic.py): a checkpoint
+        # saved under a different mesh shape must never resume silently —
+        # either hard-fail naming both topologies (elastic off) or
+        # validate the constant-global-batch invariant and record the
+        # resize (elastic on). Orbax handles the array resharding either
+        # way; this guard handles the semantics. Runs before the uneven-PP
+        # check so the operator-facing story leads with the topology.
+        resize = elastic.check_restore_topology(
+            path, meta, self.cfg, step=step, save_dir=self.directory)
+        if resize is not None:
+            # surfaced to the caller (train.build_state books/emits it);
+            # never written back to disk
+            meta["elastic_resize"] = resize
         # Checkpoints store the PP-padded layer stack. Even splits are
         # canonical (no padding), so any-topology restore works; an uneven
         # split bakes its pp into the padded shape, which a different pp

@@ -568,6 +568,15 @@ class CheckpointConfig:
     # newest step keeps the restore fallback alive.
     keep_last: int = 0
     keep_every: int = 0
+    # Elastic resume (picotron_tpu/resilience/elastic.py): allow restore
+    # into a mesh whose topology differs from the one the checkpoint was
+    # saved under (e.g. dp=2 -> dp=4 after a fleet resize). Orbax reshards
+    # the global arrays onto the new mesh; the restore validates that
+    # global_batch_size is unchanged (the token-exact cursor / loss-parity
+    # invariant) and books the restore under the `resize` goodput
+    # category. False = a topology mismatch at restore time is a hard
+    # error naming the tools/elastic_resize.py re-stamp that would fix it.
+    elastic: bool = False
 
 
 @dataclass(frozen=True)

@@ -20,6 +20,11 @@ long TPU pre-training runs in practice:
 - **hangs** (stuck collective, stalled data producer): `Watchdog` watches
   for step-loop progress, dumps every thread's Python stack on timeout, and
   exits `EXIT_WATCHDOG` non-zero so the supervisor restarts the job.
+- **topology changes** (fleet shrink/grow on spot/preemptible pods):
+  `elastic` lets a checkpoint saved at dp=N resume into a dp=M mesh —
+  topology guard at restore time, constant-global-batch resize planning,
+  ZeRO-1 shard regather/re-split, token-exact dataloader cursor carry.
+  `tools/elastic_resize.py` is the offline re-stamp CLI.
 - **testability**: `chaos` injects each of these failures deterministically
   by step (`PICOTRON_CHAOS` / `resilience.chaos`), so every recovery path
   above runs on CPU in tier-1 instead of being exercised for the first time
@@ -31,7 +36,7 @@ from "a human must look"): 75 preempted-with-durable-state, 76 diverged,
 77 watchdog-killed. See README "Fault tolerance" for the recovery matrix.
 """
 
-from picotron_tpu.resilience import chaos
+from picotron_tpu.resilience import chaos, elastic
 from picotron_tpu.resilience.guards import (
     EXIT_DIVERGED, DivergenceGuard, GuardAction,
 )
@@ -50,5 +55,6 @@ __all__ = [
     "Watchdog",
     "backoff_delays",
     "chaos",
+    "elastic",
     "retry_call",
 ]

@@ -1,0 +1,347 @@
+"""Elastic scale-out: restore a dp=N checkpoint into a dp=M mesh.
+
+The resilience stack (preemption/watchdog/guards) and the verified
+checkpoint lineage (ckpt_integrity) recover a fixed-shape world: every
+restart resumes at the topology the checkpoint was saved under. Production
+fleets shrink and grow — losing a slice of a spot/preemptible pod must
+cost a resize, not the run. This module supplies the pieces that make a
+topology change safe:
+
+- **Topology read/compare** — `saved_topology` reads the source layout a
+  step dir was committed under (the commit manifest's `topology` field;
+  meta.json's recorded config for pre-manifest checkpoints), and
+  `topology_mismatch` names the axes that differ from the restoring run's
+  mesh. `checkpoint.restore` routes every mismatch through here: hard
+  RuntimeError (naming both layouts and the fix) when elastic resume is
+  off, a validated resize when `checkpoint.elastic` is on.
+- **Resize planning at constant global batch** — `plan_resize` recomputes
+  (micro_batch_size, gradient_accumulation_steps) for a new dp so
+  `global_batch_size = mbs * ga * dp * ep` is unchanged. Constant global
+  batch is THE elastic invariant: it keeps the optimizer trajectory
+  comparable (same tokens per update) and makes the dataloader cursor —
+  which counts consumed sample blocks — valid verbatim on the resized
+  run. A dp the global batch cannot host fails with the arithmetic.
+- **Dataloader cursor translation** — `translate_dataloader_state`
+  carries the `(epoch, cursor)` position across the resize. At constant
+  global batch the position is layout-independent (the loader assembles
+  the GLOBAL batch on every process and the mesh sharding does the
+  splitting), so translation is validation + pass-through: token-exact,
+  no sample replayed, none skipped (pinned by the N->M->N sample-trace
+  test in tests/test_elastic.py).
+- **ZeRO-1 shard arithmetic** — `split_zero1` / `regather_zero1` /
+  `resize_zero1` are the host-side regather/re-split primitives for the
+  per-leaf 1/N optimizer shards described by `zero1_info`
+  (parallel/api.offload_zero1_info). Pure numpy concatenate/split along
+  the recorded shard dim: an N->M->N round trip is bitwise identity by
+  construction, which the fp32 parity test pins against a never-resized
+  twin. (For the in-mesh zero1 path the global arrays are unchanged —
+  only the sharding annotation extends over dp — so Orbax's
+  restore-to-template reshard IS the re-split; these helpers serve the
+  offload layout and the parity proof.)
+
+Two consumption flavors, both exercised by `tools/chaos.py --scenario
+dp_resize`:
+
+1. **Offline re-stamp** (`tools/elastic_resize.py`): rewrite a verified
+   step dir's meta.json for the new layout and re-commit its manifest.
+   The resumed run needs no special config — the checkpoint simply IS a
+   dp=M checkpoint afterwards.
+2. **Restore-time resize** (`checkpoint.elastic=true`): the restoring run
+   detects the mismatch, validates the constant-global-batch invariant,
+   books the restore under the `resize` goodput category, and lets Orbax
+   reshard into the target template.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+# The mesh axes a checkpoint's source topology is compared on. world_size
+# is derived (product of these); process_count is a launch detail Orbax
+# already absorbs (global arrays restore under any process->device map).
+TOPOLOGY_AXES = ("dp", "pp", "ep", "cp", "tp")
+
+
+def topology_from_distributed(dist) -> dict:
+    """Axis sizes of a DistributedConfig (or its to_json_dict() dict) in
+    the manifest's topology schema."""
+    get = (dist.get if isinstance(dist, dict)
+           else lambda k, d=None: getattr(dist, k, d))
+    topo = {ax: int(get(f"{ax}_size", 1) or 1) for ax in TOPOLOGY_AXES}
+    topo["world_size"] = int(np.prod([topo[ax] for ax in TOPOLOGY_AXES]))
+    return topo
+
+
+def describe_topology(topo: Optional[dict]) -> str:
+    """Compact operator-facing rendering: 'dp2 pp1 ep1 cp1 tp2'."""
+    if not topo:
+        return "unknown"
+    return " ".join(f"{ax}{topo.get(ax, '?')}" for ax in TOPOLOGY_AXES)
+
+
+def saved_topology(step_dir: str) -> Optional[dict]:
+    """Source topology a committed step dir was saved under: the commit
+    manifest's `topology` field when present (PR 5 lineage), else derived
+    from meta.json's recorded config (pre-manifest checkpoints), else
+    None (nothing recorded — no compatibility claim can be made)."""
+    from picotron_tpu.ckpt_integrity.manifest import MANIFEST_NAME
+
+    man_path = os.path.join(step_dir, MANIFEST_NAME)
+    try:
+        with open(man_path) as f:
+            topo = json.load(f).get("topology") or {}
+        if any(ax in topo for ax in TOPOLOGY_AXES):
+            return {k: int(v) for k, v in topo.items()
+                    if isinstance(v, (int, float))}
+    except (FileNotFoundError, json.JSONDecodeError, ValueError):
+        pass
+    try:
+        with open(os.path.join(step_dir, "meta.json")) as f:
+            dist = (json.load(f).get("config") or {}).get("distributed")
+        if dist:
+            return topology_from_distributed(dist)
+    except (FileNotFoundError, json.JSONDecodeError, ValueError):
+        pass
+    return None
+
+
+def topology_mismatch(saved: Optional[dict],
+                      current: Optional[dict]) -> list[str]:
+    """Axis names whose size differs between a saved and current topology
+    ([] when compatible or when either side recorded nothing)."""
+    if not saved or not current:
+        return []
+    return [ax for ax in TOPOLOGY_AXES
+            if saved.get(ax) is not None and current.get(ax) is not None
+            and int(saved[ax]) != int(current[ax])]
+
+
+def resize_invocation(save_dir: str, step: int, dp_new: int) -> str:
+    """The offline re-stamp command that would adapt the checkpoint to
+    this run's shape — quoted verbatim in the mismatch RuntimeError."""
+    return (f"python tools/elastic_resize.py {save_dir} "
+            f"--step {step} --dp {dp_new}")
+
+
+# ---------------------------------------------------------------------------
+# Resize planning at constant global batch
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ResizePlan:
+    """A dp resize that preserves global_batch_size = mbs * ga * dp * ep."""
+
+    dp_old: int
+    dp_new: int
+    micro_batch_size: int
+    gradient_accumulation_steps: int
+    global_batch_size: int
+
+    def overrides(self) -> dict:
+        """Config-section updates the resized run needs (merge into the
+        run config's distributed/training sections)."""
+        return {
+            "distributed": {"dp_size": self.dp_new},
+            "training": {
+                "micro_batch_size": self.micro_batch_size,
+                "gradient_accumulation_steps":
+                    self.gradient_accumulation_steps,
+            },
+        }
+
+    def overrides_line(self) -> str:
+        return (f"distributed.dp_size={self.dp_new} "
+                f"training.micro_batch_size={self.micro_batch_size} "
+                f"training.gradient_accumulation_steps="
+                f"{self.gradient_accumulation_steps}")
+
+
+def plan_resize(*, micro_batch_size: int, gradient_accumulation_steps: int,
+                dp_size: int, dp_new: int, ep_size: int = 1) -> ResizePlan:
+    """Re-factor (mbs, ga) for a new dp at constant global batch.
+
+    Preference order: keep mbs and scale ga (same per-device step shape —
+    no recompile of the microbatch program beyond the dp axis), else keep
+    ga and scale mbs, else put the whole per-replica batch into mbs.
+    Raises ValueError when the global batch cannot be split over dp_new
+    replicas at all — the caller must change global batch deliberately,
+    never have it drift under a resize."""
+    if dp_new < 1:
+        raise ValueError(f"dp_new must be >= 1, got {dp_new}")
+    gbs = micro_batch_size * gradient_accumulation_steps * dp_size * ep_size
+    per_replica = gbs // (dp_new * ep_size)
+    if per_replica * dp_new * ep_size != gbs or per_replica < 1:
+        raise ValueError(
+            f"global batch {gbs} (= mbs {micro_batch_size} x ga "
+            f"{gradient_accumulation_steps} x dp {dp_size} x ep {ep_size}) "
+            f"cannot be kept constant at dp={dp_new} x ep={ep_size}: "
+            f"{gbs} is not divisible by {dp_new * ep_size}. Elastic resize "
+            f"holds global batch fixed; pick a dp that divides it")
+    if per_replica % micro_batch_size == 0:
+        mbs, ga = micro_batch_size, per_replica // micro_batch_size
+    elif per_replica % gradient_accumulation_steps == 0:
+        mbs = per_replica // gradient_accumulation_steps
+        ga = gradient_accumulation_steps
+    else:
+        mbs, ga = per_replica, 1
+    return ResizePlan(dp_old=dp_size, dp_new=dp_new, micro_batch_size=mbs,
+                      gradient_accumulation_steps=ga,
+                      global_batch_size=gbs)
+
+
+def saved_global_batch(meta: dict) -> Optional[int]:
+    """global_batch_size recorded in a checkpoint's meta.json config, or
+    None when the checkpoint predates config recording."""
+    cfg = meta.get("config") or {}
+    tr, dist = cfg.get("training") or {}, cfg.get("distributed") or {}
+    try:
+        return (int(tr["micro_batch_size"])
+                * int(tr["gradient_accumulation_steps"])
+                * int(dist.get("dp_size", 1))
+                * int(dist.get("ep_size", 1)))
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def translate_dataloader_state(state: dict, *, gbs_old: int,
+                               gbs_new: int) -> dict:
+    """Carry a loader's (epoch, cursor) position across a resize.
+
+    The cursor counts consumed sample blocks of the GLOBAL stream, so at
+    constant global batch the position is layout-independent and carries
+    verbatim — token-exact by construction. A changed global batch is
+    only representable when the cursor lands on a whole number of new
+    steps (otherwise the position falls mid-batch: some samples would be
+    replayed or skipped), and elastic resize never changes it — so
+    anything else is a hard error, not a rounding."""
+    epoch, cursor = int(state["epoch"]), int(state["cursor"])
+    if cursor % gbs_new != 0:
+        raise ValueError(
+            f"dataloader cursor {cursor} (epoch {epoch}) consumed under "
+            f"global batch {gbs_old} does not land on a step boundary of "
+            f"global batch {gbs_new}; elastic resize requires constant "
+            f"global batch (cursor must be token-exact — no sample "
+            f"replayed, none skipped)")
+    return {"epoch": epoch, "cursor": cursor}
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 shard arithmetic (host-side, bitwise)
+# ---------------------------------------------------------------------------
+
+
+def split_zero1(full: np.ndarray, dim: int, n: int) -> list[np.ndarray]:
+    """Split one fp32 leaf into n equal ZeRO-1 shards along its recorded
+    shard dim (zero1_info's `dim`). Mirrors optimizer.z1_slice's
+    dynamic_slice_in_dim partitioning: contiguous equal blocks, shard i
+    owning rows [i*L/n, (i+1)*L/n)."""
+    if dim >= full.ndim or full.shape[dim] % n != 0:
+        raise ValueError(
+            f"cannot split shape {full.shape} into {n} shards along dim "
+            f"{dim}: {full.shape[dim] if dim < full.ndim else '?'} rows "
+            f"not divisible")
+    return [np.ascontiguousarray(s) for s in np.split(full, n, axis=dim)]
+
+
+def regather_zero1(shards: list[np.ndarray], dim: int) -> np.ndarray:
+    """Reassemble the full leaf from its per-replica 1/N shards (the
+    inverse of split_zero1; the host-side analogue of the update's
+    all-gather)."""
+    return np.concatenate(shards, axis=dim)
+
+
+def resize_zero1(shards: list[np.ndarray], dim: int,
+                 n_new: int) -> list[np.ndarray]:
+    """Re-split 1/N optimizer shards as 1/M: regather, then split. Pure
+    memory movement of the same fp32 bytes — no arithmetic touches the
+    values, so an N->M->N round trip is bitwise identity (pinned against
+    a never-resized twin in tests/test_elastic.py)."""
+    return split_zero1(regather_zero1(shards, dim), dim, n_new)
+
+
+def resize_zero1_leaves(shard_lists: list, zero1_info: list,
+                        n_new_of=None) -> list:
+    """Apply resize_zero1 across a flattened leaf list. `shard_lists[i]`
+    is the list of shards for leaf i (or a single array when
+    zero1_info[i] is None — unsharded leaves carry through untouched).
+    `n_new_of(place)` maps a leaf's (dim, axes, sizes) placement to its
+    new shard count; default = same count (identity round trip)."""
+    out = []
+    for shards, place in zip(shard_lists, zero1_info):
+        if place is None:
+            out.append(shards)
+            continue
+        dim, _axes, sizes = place
+        n_new = (n_new_of(place) if n_new_of is not None
+                 else int(np.prod(sizes)))
+        out.append(resize_zero1(shards, dim, n_new))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Restore-time compatibility check (the checkpoint.restore hook)
+# ---------------------------------------------------------------------------
+
+
+def check_restore_topology(step_dir: str, meta: dict, cfg,
+                           *, step: int, save_dir: str) -> Optional[dict]:
+    """Route a topology mismatch at restore time.
+
+    Returns None when the saved and current topologies agree (or the
+    checkpoint recorded none — pre-lineage stores keep restoring).
+    On a mismatch:
+
+    - `checkpoint.elastic` off: RuntimeError naming both topologies and
+      the `tools/elastic_resize.py` invocation that would re-stamp the
+      checkpoint for this mesh — a changed fleet shape must never resume
+      silently wrong.
+    - `checkpoint.elastic` on: validate the constant-global-batch
+      invariant (raising with the exact overrides that restore it when
+      violated) and return the resize record
+      {"from", "to", "axes"} for the caller to book/emit.
+    """
+    saved = saved_topology(step_dir)
+    current = topology_from_distributed(cfg.distributed)
+    axes = topology_mismatch(saved, current)
+    if not axes:
+        return None
+    if not getattr(cfg.checkpoint, "elastic", False):
+        raise RuntimeError(
+            f"checkpoint step {step} under {save_dir} was saved at "
+            f"topology [{describe_topology(saved)}] but this run's mesh "
+            f"is [{describe_topology(current)}] (mismatched axes: "
+            f"{', '.join(axes)}); refusing to resume silently across a "
+            f"topology change. Either restore on the saved topology, "
+            f"re-stamp the checkpoint offline with\n"
+            f"  {resize_invocation(save_dir, step, current['dp'])}\n"
+            f"or set checkpoint.elastic=true to reshard at restore time "
+            f"(global batch must stay constant)")
+    gbs_saved = saved_global_batch(meta)
+    gbs_now = cfg.global_batch_size
+    if gbs_saved is not None and gbs_saved != gbs_now:
+        plan = None
+        try:
+            tr = meta["config"]["training"]
+            plan = plan_resize(
+                micro_batch_size=int(tr["micro_batch_size"]),
+                gradient_accumulation_steps=int(
+                    tr["gradient_accumulation_steps"]),
+                dp_size=int(saved.get("dp", 1)),
+                dp_new=int(current["dp"]),
+                ep_size=int(current.get("ep", 1)))
+        except (KeyError, TypeError, ValueError):
+            pass
+        hint = (f"; to keep it constant at dp={current['dp']}: --override "
+                f"{plan.overrides_line()}" if plan is not None else "")
+        raise RuntimeError(
+            f"elastic restore of step {step} ({describe_topology(saved)} "
+            f"-> {describe_topology(current)}) changes global_batch_size "
+            f"{gbs_saved} -> {gbs_now}, which breaks the token-exact "
+            f"dataloader cursor and the loss-parity guarantee{hint}")
+    return {"from": saved, "to": current, "axes": axes}

@@ -12,6 +12,10 @@ Every timed second of the run is booked to exactly one category:
                      before, so their time buys back lost ground, not new
                      progress.
 - ``restore``      — checkpoint restore (rollback or resume).
+- ``resize``       — an elastic restore: resuming a checkpoint saved at a
+                     different topology (resilience/elastic.py), booked
+                     apart from plain restores so shrink/grow cost is
+                     measured, not guessed.
 - ``ckpt_io``      — periodic checkpoint saves.
 - ``preempt``      — preemption drain: the emergency save between SIGTERM
                      and exit 75.
@@ -60,11 +64,16 @@ PHASE_CATEGORY = {
     "save": "ckpt_io",
     "rollback": "restore",
     "restore": "restore",
+    # elastic restore across a topology change (resilience/elastic.py):
+    # train.py books the restore phase as "resize" when the checkpoint's
+    # source topology differs from the run's mesh
+    "resize": "resize",
     "preempt-save": "preempt",
 }
 
 CATEGORIES = (
-    "compute", "compile", "replay", "restore", "ckpt_io", "preempt",
+    "compute", "compile", "replay", "restore", "resize", "ckpt_io",
+    "preempt",
     "retry_backoff", "data_wait", "host_sync", "pp_bubble", "eval",
     "other",
     # serving (picotron_tpu/serve): device time in the two jitted

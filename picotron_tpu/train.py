@@ -45,7 +45,7 @@ from picotron_tpu.parallel.api import (
 )
 from picotron_tpu.resilience import (
     EXIT_DIVERGED, EXIT_PREEMPTED, DivergenceGuard, GuardAction,
-    PreemptionHandler, Watchdog, chaos,
+    PreemptionHandler, Watchdog, chaos, elastic,
 )
 from picotron_tpu.telemetry import Telemetry, bus as telemetry_bus
 from picotron_tpu.train_step import TrainState
@@ -90,12 +90,37 @@ def build_state(cfg: Config, menv: MeshEnv, tel: Telemetry = None) \
     if load_dir:
         if mgr is None:
             mgr = CheckpointManager(cfg, menv, directory=load_dir)
+        # An elastic restore across a topology change is booked under the
+        # `resize` goodput category, not `restore`, so shrink/grow cost is
+        # measured apart from plain resumes. The phase name must be chosen
+        # before the phase opens, so probe the newest valid step's source
+        # topology up front (cheap manifest read; restore re-checks it
+        # authoritatively).
+        phase_name = "restore"
+        if cfg.checkpoint.elastic:
+            probe_step = mgr.latest_valid_step()
+            if probe_step is not None:
+                saved = elastic.saved_topology(mgr._step_dir(probe_step))
+                here = elastic.topology_from_distributed(cfg.distributed)
+                if elastic.topology_mismatch(saved, here):
+                    phase_name = "resize"
         if tel is not None:
-            with tel.phases.phase("restore"):
+            with tel.phases.phase(phase_name):
                 state, meta = mgr.restore(state)
         else:
             state, meta = mgr.restore(state)
         tokens = meta.get("trained_tokens", 0)
+        resize = meta.get("elastic_resize")
+        if resize:
+            if tel is not None:
+                tel.emit("elastic_resize", step=int(state.step),
+                         **{k: resize[k] for k in ("from", "to", "axes")})
+            log_print(
+                f"elastic resize: restored step {int(state.step)} saved "
+                f"at [{elastic.describe_topology(resize['from'])}] into "
+                f"[{elastic.describe_topology(resize['to'])}] "
+                f"(axes: {', '.join(resize['axes'])}; global batch "
+                f"{cfg.global_batch_size} unchanged)")
         log_print(f"resumed from {load_dir} at step "
                   f"{int(state.step)} ({human_format(tokens)} tokens)")
         return state, int(state.step), tokens, meta, load_dir

@@ -214,9 +214,43 @@ def test_gc_keeps_last_verified_with_keep_last_1(tmp_path):
 
 
 def test_restore_across_topologies(tmp_path):
-    """Save under dp=2,tp=2 / restore under tp=4: Orbax reshards into the
-    template's shardings — the reference hard-fails on this
-    (ref: checkpoint.py:263 resume assumes identical topology)."""
+    """Save under dp=2,tp=2 / restore under dp=1,tp=4 with
+    checkpoint.elastic on: Orbax reshards into the template's shardings —
+    the reference hard-fails on this (ref: checkpoint.py:263 resume
+    assumes identical topology). Gradient accumulation doubles so the
+    global batch is unchanged (the elastic invariant); the restore must
+    surface the resize record it booked."""
+    import dataclasses
+
+    cfg_a = make_cfg(tmp_path, dp_size=2, tp_size=2)
+    menv_a = MeshEnv.from_config(cfg_a)
+    state = init_sharded_state(cfg_a, menv_a, jax.random.key(0))
+    CheckpointManager(cfg_a, menv_a).save(state)
+
+    cfg_b = make_cfg(tmp_path, tp_size=4)
+    cfg_b = dataclasses.replace(
+        cfg_b,
+        training=dataclasses.replace(cfg_b.training,
+                                     gradient_accumulation_steps=2),
+        checkpoint=dataclasses.replace(cfg_b.checkpoint, elastic=True))
+    assert cfg_b.global_batch_size == cfg_a.global_batch_size
+    menv_b = MeshEnv.from_config(cfg_b)
+    template = init_sharded_state(cfg_b, menv_b, jax.random.key(1))
+    restored, meta = CheckpointManager(cfg_b, menv_b).restore(template)
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["layers"]["q"]),
+        np.asarray(state.params["layers"]["q"]))
+    # restored arrays carry the *new* topology's shardings
+    assert restored.params["layers"]["q"].sharding == template.params["layers"]["q"].sharding
+    resize = meta["elastic_resize"]
+    assert sorted(resize["axes"]) == ["dp", "tp"]
+    assert resize["from"]["dp"] == 2 and resize["to"]["dp"] == 1
+
+
+def test_restore_topology_mismatch_raises_without_elastic(tmp_path):
+    """The satellite bugfix: restoring into a mismatching mesh with
+    elastic OFF must be a hard error naming both topologies and the
+    offline re-stamp invocation — never a silent wrong-shape resume."""
     cfg_a = make_cfg(tmp_path, dp_size=2, tp_size=2)
     menv_a = MeshEnv.from_config(cfg_a)
     state = init_sharded_state(cfg_a, menv_a, jax.random.key(0))
@@ -225,12 +259,13 @@ def test_restore_across_topologies(tmp_path):
     cfg_b = make_cfg(tmp_path, tp_size=4)
     menv_b = MeshEnv.from_config(cfg_b)
     template = init_sharded_state(cfg_b, menv_b, jax.random.key(1))
-    restored, _ = CheckpointManager(cfg_b, menv_b).restore(template)
-    np.testing.assert_array_equal(
-        np.asarray(restored.params["layers"]["q"]),
-        np.asarray(state.params["layers"]["q"]))
-    # restored arrays carry the *new* topology's shardings
-    assert restored.params["layers"]["q"].sharding == template.params["layers"]["q"].sharding
+    with pytest.raises(RuntimeError) as exc:
+        CheckpointManager(cfg_b, menv_b).restore(template)
+    msg = str(exc.value)
+    assert "dp2 pp1 ep1 cp1 tp2" in msg    # saved topology
+    assert "dp1 pp1 ep1 cp1 tp4" in msg    # this run's mesh
+    assert "tools/elastic_resize.py" in msg
+    assert "checkpoint.elastic" in msg
 
 
 def test_hf_safetensors_roundtrip(tmp_path):

@@ -101,6 +101,75 @@ def test_too_small_dataset_raises():
         MicroBatchDataLoader(cfg, menv)
 
 
+def _rows(batch):
+    """Flatten one (ids, tgt) batch to its global sample rows, layout-
+    free: [ga, mbs*dp*ep, seq] -> [gbs, seq] in C order — the same order
+    _assemble_next consumed the source, whatever the factorization."""
+    ids = np.asarray(batch[0])
+    return ids.reshape(-1, ids.shape[-1])
+
+
+def _layout_cfg(dp, mbs, ga, num_samples=16):
+    return Config(
+        distributed=DistributedConfig(dp_size=dp),
+        model=ModelConfig(),
+        training=TrainingConfig(seq_length=32, micro_batch_size=mbs,
+                                gradient_accumulation_steps=ga,
+                                num_samples=num_samples),
+    )
+
+
+def test_cursor_is_layout_independent_at_constant_global_batch():
+    """The elastic-resize cursor invariant (resilience/elastic.py): at
+    constant global batch the (epoch, cursor) position is process-count
+    independent, so a dp=2 run's state carries verbatim into dp=1 and
+    dp=4 layouts. Pinned token-exactly: a 2+2+2-step N->M->N trace
+    through three factorizations of gbs=4 must reproduce the never-
+    resized stream sample for sample — none replayed, none skipped —
+    including across an epoch wrap (16 blocks / 4 per step = 4 steps
+    per epoch, so the trace wraps inside the dp=4 leg)."""
+    layouts = [(2, 2, 1), (1, 2, 2), (4, 1, 1)]  # (dp, mbs, ga), gbs=4
+
+    cfg0 = _layout_cfg(*layouts[0])
+    dl = MicroBatchDataLoader(cfg0, MeshEnv.from_config(cfg0))
+    pure = [_rows(next(dl)) for _ in range(6)]
+
+    traced, state = [], None
+    for dp, mbs, ga in layouts:
+        cfg = _layout_cfg(dp, mbs, ga)
+        assert cfg.global_batch_size == cfg0.global_batch_size
+        leg = MicroBatchDataLoader(cfg, MeshEnv.from_config(cfg))
+        if state is not None:
+            leg.set_state(state)
+        traced += [_rows(next(leg)) for _ in range(2)]
+        state = leg.state
+    assert state == {"epoch": 1, "cursor": 8}  # wrapped, 2 steps in
+    for step, (want, got) in enumerate(zip(pure, traced), start=1):
+        np.testing.assert_array_equal(want, got,
+                                      err_msg=f"step {step} diverged")
+
+
+def test_reset_repositions_across_changed_layout():
+    """reset() (the mid-run form of set_state, used by rollback) honors a
+    cursor recorded under a different gbs factorization: a dp=1 loader
+    reset to a dp=2 run's position continues the dp=2 stream exactly."""
+    cfg_a = _layout_cfg(2, 2, 1)
+    dl_a = MicroBatchDataLoader(cfg_a, MeshEnv.from_config(cfg_a))
+    for _ in range(2):
+        next(dl_a)
+    mark = dl_a.state
+    want = [_rows(next(dl_a)) for _ in range(2)]
+
+    cfg_b = _layout_cfg(1, 2, 2)
+    dl_b = MicroBatchDataLoader(cfg_b, MeshEnv.from_config(cfg_b))
+    next(dl_b)  # consume from the start, then jump
+    dl_b.reset(mark)
+    got = [_rows(next(dl_b)) for _ in range(2)]
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    assert dl_b.state == dl_a.state
+
+
 def test_tokenize_and_chunk():
     datasets = pytest.importorskip("datasets")
 

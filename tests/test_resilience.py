@@ -540,7 +540,7 @@ def test_chaos_cli_lists_every_scenario(capsys):
     assert cli.main(["--list"]) == 0
     out = capsys.readouterr().out
     for name in ("sigterm", "ckpt_io", "nan_skip", "nan_rollback",
-                 "data_stall", "ckpt_corrupt_bitflip"):
+                 "data_stall", "ckpt_corrupt_bitflip", "dp_resize"):
         assert name in out
 
 
@@ -606,3 +606,31 @@ def test_chaos_scenario_recovers_to_baseline(tmp_path, scenario):
             f"{scenario}: event {kind!r} absent: {s['events']}"
     if scenario in ("nan_rollback", "ckpt_corrupt_bitflip"):
         assert s["steps"]["replayed"] > 0  # re-trained ground is counted
+
+
+@pytest.mark.slow
+def test_chaos_dp_resize_scenario(tmp_path):
+    """Elastic scale-out, the full multi-process scenario: dp=2 SIGKILLed,
+    re-stamped to dp=1 offline, SIGKILLed again, finished at dp=4 via
+    checkpoint.elastic. run_dp_resize itself asserts final step/tokens,
+    per-step loss-trajectory parity vs the fault-free dp=2 baseline, the
+    `resize` goodput booking, and the elastic_resize event; here we
+    additionally pin that the whole saga trained every step exactly once
+    (no replay — a resize costs restore time, not ground)."""
+    import importlib.util
+
+    cli = _load_chaos_cli()
+    assert cli.run_dp_resize(str(tmp_path))
+
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report", os.path.join(os.path.dirname(__file__), "..",
+                                         "tools", "telemetry_report.py"))
+    rep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rep)
+    stream = os.path.join(tmp_path, "fault", "ckpt", "telemetry.jsonl")
+    s = rep.summarize(rep.load_events(stream))
+    assert s["steps"]["count"] == cli.STEPS
+    assert s["steps"]["max"] == cli.STEPS
+    assert s["steps"]["replayed"] == 0
+    assert s["categories"].get("resize", 0.0) > 0
+    assert s["resize"]["events"] >= 1

@@ -22,6 +22,13 @@ Scenarios (the runtime-failure matrix README "Fault tolerance" documents):
                 SIGKILL -> restart falls back to the prior verified step
                 (manifest verification + lineage walk); ckpt_doctor must
                 flag exactly the injected-corrupt step
+  dp_resize     elastic scale-out: dp=2 run SIGKILLed mid-training,
+                re-stamped to dp=1 offline (tools/elastic_resize.py),
+                killed again, then restored into a dp=4 mesh via
+                checkpoint.elastic — constant global batch throughout,
+                final step/tokens AND the per-step loss trajectory must
+                match the fault-free dp=2 baseline, and the resize
+                seconds must land in the `resize` goodput category
 
 Usage:
 
@@ -134,6 +141,158 @@ SCENARIOS: dict[str, Scenario] = {
             save_dir, corrupt_step=STEPS - 2),
     ),
 }
+
+
+def run_dp_resize(workdir: str, verbose: bool = False) -> bool:
+    """Elastic scale-out scenario — three topologies, one training run.
+
+    Doesn't fit the Scenario dataclass (every leg needs its own config),
+    so it is a custom runner registered next to SCENARIOS:
+
+      baseline  dp=2 mbs=2 ga=1, fault-free, steps 1-6
+      leg 1     dp=2, SIGKILL at step-3 begin (save @2 committed first)
+      re-stamp  tools/elastic_resize.py --dp 1 rewrites the store offline
+      leg 2     dp=1 mbs=2 ga=2, elastic OFF (the re-stamped store now IS
+                dp=1), SIGKILL at step-5 begin (save @4 committed first)
+      leg 3     dp=4 mbs=1 ga=1, checkpoint.elastic=true — the runtime
+                resize path restores the dp=1-stamped step 4 into a dp=4
+                mesh, trains to completion
+
+    Global batch is 4 in every leg (2x2x1 = 2x1x2 = 1x4x1), so the loss
+    trajectory is the baseline's modulo fp32 reduction order — compared
+    per-step with tight tolerances. The resize must be booked: `resize`
+    seconds and an `elastic_resize` event in the telemetry stream."""
+    import numpy as np
+
+    fail = lambda msg: (print(f"[chaos-cli] dp_resize: FAIL — {msg}"),  # noqa: E731
+                        False)[1]
+
+    def leg_config(ckpt_dir: str, *, dp: int, mbs: int, ga: int,
+                   chaos_spec: str = "", elastic: bool = False) -> dict:
+        cfg = scenario_config(os.path.dirname(ckpt_dir), chaos_spec,
+                              {"checkpoint": {"async_save": False}})
+        cfg["distributed"]["dp_size"] = dp
+        cfg["training"]["micro_batch_size"] = mbs
+        cfg["training"]["gradient_accumulation_steps"] = ga
+        cfg["checkpoint"]["save_dir"] = ckpt_dir
+        if elastic:
+            cfg["checkpoint"]["elastic"] = True
+        return cfg
+
+    def run_leg(cfg: dict, cfg_name: str, leg_dir: str) -> int:
+        cfg_path = os.path.join(leg_dir, cfg_name)
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+        return _run_trainer(cfg_path, os.path.join(leg_dir, "run.log"), {})
+
+    def step_losses(jsonl_path: str) -> dict:
+        losses = {}
+        with open(jsonl_path) as f:
+            for line in f:
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn final line of a killed leg
+                if ev.get("kind") == "step" and "loss" in ev:
+                    losses[ev["step"]] = ev["loss"]  # last wins (replay)
+        return losses
+
+    # Fault-free dp=2 baseline: the trajectory every leg must stay on.
+    base_dir = os.path.join(workdir, "baseline")
+    os.makedirs(base_dir, exist_ok=True)
+    base_ckpt = os.path.join(base_dir, "ckpt")
+    rc = run_leg(leg_config(base_ckpt, dp=2, mbs=2, ga=1),
+                 "config.json", base_dir)
+    if rc != 0:
+        return fail(f"baseline run exited {rc}")
+    base_meta = _final_meta(base_ckpt)
+
+    fault_dir = os.path.join(workdir, "fault")
+    os.makedirs(fault_dir, exist_ok=True)
+    ckpt_dir = os.path.join(fault_dir, "ckpt")
+
+    # Leg 1: dp=2, killed at step-3 begin; the sync save @2 is durable.
+    rc = run_leg(leg_config(ckpt_dir, dp=2, mbs=2, ga=1,
+                            chaos_spec=f"kill@{STEPS // 2}"),
+                 "config_dp2.json", fault_dir)
+    if rc != -signal.SIGKILL:
+        return fail(f"leg 1 (dp=2) exited {rc}, expected "
+                    f"{-signal.SIGKILL} (SIGKILL)")
+
+    # Offline re-stamp: the store becomes a dp=1 checkpoint (constant
+    # global batch -> mbs 2 x ga 2), manifest re-committed.
+    resize_log = os.path.join(fault_dir, "resize.log")
+    with open(resize_log, "ab") as log:
+        rc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "elastic_resize.py"),
+             ckpt_dir, "--dp", "1"],
+            stdout=log, stderr=subprocess.STDOUT, timeout=120).returncode
+    if rc != 0:
+        return fail(f"tools/elastic_resize.py --dp 1 exited {rc} "
+                    f"(see {resize_log})")
+
+    # Leg 2: dp=1, elastic OFF — restoring the re-stamped store must need
+    # no special config. Killed at step-5 begin; sync save @4 durable.
+    rc = run_leg(leg_config(ckpt_dir, dp=1, mbs=2, ga=2,
+                            chaos_spec=f"kill@{STEPS - 1}"),
+                 "config_dp1.json", fault_dir)
+    if rc != -signal.SIGKILL:
+        return fail(f"leg 2 (dp=1) exited {rc}, expected "
+                    f"{-signal.SIGKILL} (SIGKILL)")
+
+    # Leg 3: dp=4 with checkpoint.elastic — the runtime resize path
+    # restores the dp=1-stamped step 4 into a dp=4 mesh and finishes.
+    rc = run_leg(leg_config(ckpt_dir, dp=4, mbs=1, ga=1, elastic=True),
+                 "config_dp4.json", fault_dir)
+    if rc != 0:
+        return fail(f"leg 3 (dp=4, elastic) exited {rc}, expected 0")
+
+    with open(os.path.join(fault_dir, "run.log")) as f:
+        log_text = f.read()
+    if verbose:
+        print(log_text)
+    if not re.search(r"elastic resize:", log_text):
+        return fail("marker /elastic resize:/ absent from the leg-3 log")
+
+    meta = _final_meta(ckpt_dir)
+    for key in ("step", "trained_tokens"):
+        if meta[key] != base_meta[key]:
+            return fail(f"final {key} {meta[key]} != fault-free baseline "
+                        f"{base_meta[key]}")
+
+    # Loss-trajectory parity: same global batch, same data order -> the
+    # only legitimate difference across dp=2/1/4 is fp32 reduction order.
+    base_losses = step_losses(os.path.join(base_ckpt, "telemetry.jsonl"))
+    fault_losses = step_losses(os.path.join(ckpt_dir, "telemetry.jsonl"))
+    if set(fault_losses) != set(base_losses):
+        return fail(f"step sets differ: fault {sorted(fault_losses)} vs "
+                    f"baseline {sorted(base_losses)}")
+    steps = sorted(base_losses)
+    bl = np.array([base_losses[s] for s in steps])
+    fl = np.array([fault_losses[s] for s in steps])
+    if not np.allclose(fl, bl, rtol=1e-3, atol=1e-4):
+        return fail(f"loss trajectory diverged from baseline: "
+                    f"{list(zip(steps, fl.tolist(), bl.tolist()))}")
+
+    # The resize must be booked, not just survived.
+    import telemetry_report
+
+    summary = telemetry_report.summarize(telemetry_report.load_events(
+        os.path.join(ckpt_dir, "telemetry.jsonl")))
+    if summary["categories"].get("resize", 0.0) <= 0.0:
+        return fail(f"no `resize` seconds in the goodput categories "
+                    f"({summary['categories']})")
+    if not summary.get("resize", {}).get("events"):
+        return fail("no elastic_resize event in the telemetry stream")
+
+    print(f"[chaos-cli] dp_resize: OK — dp 2->1 (offline re-stamp) ->4 "
+          f"(runtime elastic), final step {meta['step']} / "
+          f"{meta['trained_tokens']} tokens and loss trajectory match "
+          f"baseline; resize booked "
+          f"{summary['categories']['resize']:.3f}s")
+    return True
 
 
 def _doctor_flags_exactly(save_dir: str, corrupt_step: int):
@@ -270,11 +429,23 @@ def run_scenario(name: str, workdir: str, verbose: bool = False) -> bool:
     return True
 
 
+# Scenarios with bespoke runners (multiple per-leg configs, offline CLI
+# steps): registered next to the Scenario table so --list/--scenario/--all
+# treat them uniformly.
+CUSTOM_SCENARIOS: dict[str, tuple[Callable, str]] = {
+    "dp_resize": (run_dp_resize,
+                  "elastic scale-out: SIGKILL a dp=2 run, re-stamp to "
+                  "dp=1 offline, SIGKILL again, finish at dp=4 via "
+                  "checkpoint.elastic; loss-trajectory parity vs the "
+                  "dp=2 baseline, resize seconds booked"),
+}
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         description="picotron-tpu fault-recovery scenario runner")
     ap.add_argument("--scenario", action="append", default=[],
-                    choices=sorted(SCENARIOS),
+                    choices=sorted(set(SCENARIOS) | set(CUSTOM_SCENARIOS)),
                     help="scenario to run (repeatable)")
     ap.add_argument("--all", action="store_true",
                     help="run every scenario")
@@ -292,10 +463,12 @@ def main(argv=None) -> int:
     if args.list:
         for name, sc in SCENARIOS.items():
             print(f"{name:14s} chaos={sc.chaos!r:24s} {sc.note}")
+        for name, (_fn, note) in CUSTOM_SCENARIOS.items():
+            print(f"{name:14s} chaos={'custom':26s} {note}")
         return 0
     names = sorted(set(args.scenario)) if args.scenario else []
     if args.all:
-        names = sorted(SCENARIOS)
+        names = sorted(set(SCENARIOS) | set(CUSTOM_SCENARIOS))
     if not names:
         build_parser().error("pick --scenario NAME (repeatable), --all, "
                              "or --list")
@@ -308,7 +481,10 @@ def main(argv=None) -> int:
     for name in names:
         sub = os.path.join(workdir, name)
         os.makedirs(sub, exist_ok=True)
-        ok &= run_scenario(name, sub, verbose=args.verbose)
+        if name in CUSTOM_SCENARIOS:
+            ok &= CUSTOM_SCENARIOS[name][0](sub, verbose=args.verbose)
+        else:
+            ok &= run_scenario(name, sub, verbose=args.verbose)
     print(f"[chaos-cli] {'all scenarios recovered' if ok else 'FAILURES'} "
           f"(workdir {workdir})")
     return 0 if ok else 1

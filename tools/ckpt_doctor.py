@@ -39,6 +39,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from picotron_tpu.checkpoint import CheckpointManager  # noqa: E402
 from picotron_tpu.config import CheckpointConfig, Config  # noqa: E402
+from picotron_tpu.resilience import elastic  # noqa: E402
 
 
 def _manager(save_dir: str, keep_last: int = 0,
@@ -73,6 +74,10 @@ def scan(save_dir: str, deep: bool = True,
             "files": man.get("file_count"),
             "bytes": man.get("total_bytes"),
             "algo": man.get("algo"),
+            # source topology the step was saved under (manifest field,
+            # meta.json fallback for legacy steps) — what an operator
+            # must know before attempting an elastic resize
+            "topology": elastic.saved_topology(mgr._step_dir(step)),
             "failures": list(res.failures),
         })
     return rows
@@ -83,19 +88,25 @@ def render(rows: list[dict], save_dir: str, markdown: bool = False) -> str:
     if markdown:
         lines.append(f"## ckpt_doctor — `{save_dir}`")
         lines.append("")
-        lines.append("| step | verdict | files | bytes | failures |")
-        lines.append("|---:|---|---:|---:|---|")
+        lines.append("| step | verdict | topology | files | bytes | "
+                     "failures |")
+        lines.append("|---:|---|---|---:|---:|---|")
         for r in rows:
             fails = "; ".join(r["failures"][:3]) or ""
-            lines.append(f"| {r['step']} | {r['verdict']} | "
+            topo = (elastic.describe_topology(r["topology"])
+                    if r.get("topology") else "-")
+            lines.append(f"| {r['step']} | {r['verdict']} | {topo} | "
                          f"{r['files'] or ''} | {r['bytes'] or ''} | "
                          f"{fails} |")
     else:
         lines.append(f"[ckpt_doctor] {save_dir}: {len(rows)} step dir(s)")
         for r in rows:
+            topo = (elastic.describe_topology(r["topology"])
+                    if r.get("topology") else "-")
             extra = (f"  ({r['files']} files, {r['bytes']} bytes, "
                      f"{r['algo']})" if r["files"] is not None else "")
-            lines.append(f"  step {r['step']:>8d}  {r['verdict']:<11s}{extra}")
+            lines.append(f"  step {r['step']:>8d}  {r['verdict']:<11s} "
+                         f"[{topo}]{extra}")
             for f in r["failures"][:5]:
                 lines.append(f"           !! {f}")
             if len(r["failures"]) > 5:

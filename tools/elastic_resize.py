@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Offline elastic re-stamp: adapt a verified checkpoint to a new dp.
+
+`python tools/elastic_resize.py CKPT_DIR --dp M [--step N] [--dry-run]`
+
+The restore path (picotron_tpu/checkpoint.py) refuses to resume a
+checkpoint into a mesh whose topology differs from the one it was saved
+under — unless `checkpoint.elastic` is on, or the checkpoint has been
+re-stamped by this tool. Re-stamping rewrites the step's meta.json for
+the new layout (dp_size, plus micro_batch_size/gradient_accumulation_
+steps re-factored at CONSTANT global batch — the token-exact cursor /
+loss-parity invariant) and re-commits the manifest with the new source
+topology, so the resumed run needs no special config: the checkpoint
+simply IS a dp=M checkpoint afterwards. The Orbax array data is not
+touched — global shapes are layout-independent and Orbax reshards onto
+whatever mesh restores them.
+
+Safety: the step is deep-verified against its commit manifest BEFORE
+anything is rewritten. Re-stamping rebuilds the manifest from the
+current bytes, so running it on a corrupt store would bless the
+corruption as "verified" — the tool hard-refuses instead. A legacy step
+(pre-manifest lineage) gets its meta.json rewritten but NO manifest:
+this tool never manufactures a verification claim the original commit
+didn't make.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from picotron_tpu.ckpt_integrity.manifest import (  # noqa: E402
+    atomic_write_text, build_manifest, verify_step_dir, write_manifest,
+)
+from picotron_tpu.resilience import elastic  # noqa: E402
+
+STEP_RE = re.compile(r"^step_(\d{8})$")
+
+
+def list_steps(save_dir: str) -> list[int]:
+    try:
+        names = os.listdir(save_dir)
+    except FileNotFoundError:
+        return []
+    return sorted(int(m.group(1)) for n in names
+                  if (m := STEP_RE.match(n)))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="re-stamp a checkpoint step for a new dp size "
+                    "(constant global batch)")
+    ap.add_argument("save_dir", help="checkpoint directory (the trainer's "
+                    "checkpoint.save_dir, containing step_XXXXXXXX dirs)")
+    ap.add_argument("--dp", type=int, required=True,
+                    help="target data-parallel size")
+    ap.add_argument("--step", type=int, default=None,
+                    help="step to re-stamp (default: newest step that "
+                         "passes verification)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the plan without touching the store")
+    args = ap.parse_args(argv)
+
+    steps = list_steps(args.save_dir)
+    if not steps:
+        print(f"no checkpoint steps under {args.save_dir}",
+              file=sys.stderr)
+        return 1
+    if args.step is not None:
+        if args.step not in steps:
+            print(f"step {args.step} not found under {args.save_dir}; "
+                  f"available: {steps}", file=sys.stderr)
+            return 1
+        step = args.step
+    else:
+        step = next((s for s in reversed(steps)
+                     if verify_step_dir(
+                         os.path.join(args.save_dir,
+                                      f"step_{s:08d}")).ok), None)
+        if step is None:
+            print(f"no step under {args.save_dir} passes verification",
+                  file=sys.stderr)
+            return 1
+    step_dir = os.path.join(args.save_dir, f"step_{step:08d}")
+
+    # Deep-verify BEFORE mutating: re-stamping rebuilds the manifest from
+    # the bytes on disk, so a corrupt store would come out "verified" —
+    # refuse rather than launder corruption into the lineage.
+    res = verify_step_dir(step_dir, deep=True)
+    if res.status == "corrupt":
+        print(f"step {step} fails verification "
+              f"({'; '.join(res.failures[:3])}); refusing to re-stamp a "
+              f"corrupt checkpoint", file=sys.stderr)
+        return 1
+
+    with open(os.path.join(step_dir, "meta.json")) as f:
+        meta = json.load(f)
+    cfg = meta.get("config") or {}
+    tr, dist = cfg.get("training") or {}, cfg.get("distributed") or {}
+    if "micro_batch_size" not in tr or "dp_size" not in dist:
+        print(f"step {step}'s meta.json records no training/distributed "
+              f"config; cannot plan a constant-global-batch resize",
+              file=sys.stderr)
+        return 1
+
+    saved = elastic.saved_topology(step_dir) or {}
+    try:
+        plan = elastic.plan_resize(
+            micro_batch_size=int(tr["micro_batch_size"]),
+            gradient_accumulation_steps=int(
+                tr["gradient_accumulation_steps"]),
+            dp_size=int(dist["dp_size"]),
+            dp_new=args.dp,
+            ep_size=int(dist.get("ep_size", 1)))
+    except ValueError as e:
+        print(f"cannot resize step {step}: {e}", file=sys.stderr)
+        return 1
+
+    dl_state = meta.get("dataloader")
+    if dl_state:
+        # constant global batch -> pass-through; still validated so a
+        # hand-edited store can't smuggle in a mid-batch cursor
+        dl_state = elastic.translate_dataloader_state(
+            dl_state, gbs_old=plan.global_batch_size,
+            gbs_new=plan.global_batch_size)
+
+    new_topo = {ax: int(saved.get(ax, dist.get(f"{ax}_size", 1)))
+                for ax in elastic.TOPOLOGY_AXES}
+    new_topo["dp"] = plan.dp_new
+    new_topo["world_size"] = 1
+    for ax in elastic.TOPOLOGY_AXES:
+        new_topo["world_size"] *= new_topo[ax]
+
+    print(f"step {step} under {args.save_dir} ({res.status}):")
+    print(f"  topology  [{elastic.describe_topology(saved or None)}] -> "
+          f"[{elastic.describe_topology(new_topo)}]")
+    print(f"  batch     mbs {tr['micro_batch_size']} x ga "
+          f"{tr['gradient_accumulation_steps']} x dp {dist['dp_size']} "
+          f"-> mbs {plan.micro_batch_size} x ga "
+          f"{plan.gradient_accumulation_steps} x dp {plan.dp_new} "
+          f"(global batch {plan.global_batch_size}, unchanged)")
+    if dl_state:
+        print(f"  cursor    epoch {dl_state['epoch']}, sample "
+              f"{dl_state['cursor']} (token-exact carry)")
+    if args.dry_run:
+        print("dry run: store not modified")
+        return 0
+
+    meta["config"]["distributed"]["dp_size"] = plan.dp_new
+    meta["config"]["training"]["micro_batch_size"] = plan.micro_batch_size
+    meta["config"]["training"]["gradient_accumulation_steps"] = \
+        plan.gradient_accumulation_steps
+    meta["elastic_restamp"] = {
+        "from": saved or None, "to": new_topo,
+        "tool": "tools/elastic_resize.py",
+    }
+    atomic_write_text(os.path.join(step_dir, "meta.json"),
+                      json.dumps(meta, indent=1, sort_keys=True))
+
+    if res.status == "verified":
+        # meta.json's bytes changed, so the manifest must be re-committed
+        # (it content-hashes every file) — with the new source topology.
+        write_manifest(step_dir, build_manifest(step_dir, step=step,
+                                                topology=new_topo))
+        after = verify_step_dir(step_dir, deep=True)
+        if after.status != "verified":
+            print(f"re-stamp left step {step} unverified "
+                  f"({'; '.join(after.failures[:3])})", file=sys.stderr)
+            return 1
+        print(f"  manifest  re-committed, step re-verified")
+    else:
+        print(f"  manifest  none (legacy step) — meta.json rewritten only")
+    print(f"resume with distributed.dp_size={plan.dp_new} "
+          f"training.micro_batch_size={plan.micro_batch_size} "
+          f"training.gradient_accumulation_steps="
+          f"{plan.gradient_accumulation_steps} (checkpoint.elastic not "
+          f"required: the store now records this topology)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

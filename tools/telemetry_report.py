@@ -165,6 +165,15 @@ def summarize(events: list[dict]) -> dict:
         out["training"]["final_val_loss"] = eval_rows[-1].get("val_loss")
     if serve_reqs or serve_summary:
         out["serving"] = serving_view(serve_reqs, serve_summary)
+    # Elastic-resize row: the resize category already sums into the table
+    # above (the phase event carries its resolved category); this pairs
+    # the seconds with the elastic_resize events so a shrink/grow saga is
+    # one row, not a grep.
+    n_resize = counts.get("elastic_resize", 0)
+    resize_s = categories.get("resize", 0.0)
+    if n_resize or resize_s:
+        out["resize"] = {"events": n_resize,
+                         "seconds": round(resize_s, 4)}
     pp = pipeline_view(categories, run_summary)
     if pp:
         out["pipeline"] = pp
@@ -359,6 +368,12 @@ def render(s: dict, markdown: bool = False) -> str:
             f"{pair('pool_peak_utilization')} | decode steps "
             f"{pair('decode_steps')} (compiles {pair('decode_compiles')}) "
             f"| preemptions {pair('preemptions')}")
+        lines.append("")
+    rz = s.get("resize")
+    if rz:
+        msg = (f"elastic resize: {rz['events']} topology-change "
+               f"restore(s), {rz['seconds']:.3f}s booked as resize")
+        lines.append(f"**{msg}**" if markdown else msg)
         lines.append("")
     ev = ", ".join(f"{k}={v}" for k, v in s["events"].items())
     lines.append(f"events: {ev}" if not markdown else f"**events:** {ev}")
