@@ -21,6 +21,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import time
 
 import jax
@@ -335,25 +336,39 @@ def make_serve_trace(n_requests: int, rate: float, prompt_len: int,
     return out
 
 
+# Printed (stderr) and embedded in the JSON whenever a wall-clock ratio
+# is reported from a CPU host: the 0.85-1.19 spread PERF.md r7 recorded
+# was host-load noise being read as a regression/win.
+_WALL_NOTE = ("wall-clock ratios on a shared CPU host are load-noisy "
+              "(observed 0.85-1.19x swings on identical configs); the "
+              "deterministic decode_slot_steps ratio is the headline — "
+              "treat wall numbers as median-of-repeats sanity only, and "
+              "use the PERF.md on-TPU protocol for real speedups")
+
+
 def run_serve(model: str, layers, *, slots: int, block_size: int,
               num_blocks: int, prefill_chunk: int, prompt_len: int,
               max_new: int, n_requests: int, rate: float, tp: int = 1,
               decode_interval: int = 4, seed: int = 0,
+              repeats: int = 3,
               telemetry: str | None = None) -> dict:
     """Continuous batching + paged KV cache (picotron_tpu/serve) against
     the batch-static `generate` baseline, on the same synthetic arrival
-    trace. One JSON line: serving tokens/s as the headline value,
-    `vs_static` as the continuous-batching win (ragged lengths stop
-    costing max-length decode steps; finished slots refill instead of
-    idling), plus the SLO view (p50/p95 TTFT, per-token latency, queue
-    wait) and engine health (slot occupancy, pool utilization,
-    preemptions, decode compiles — the last must be 1).
+    trace. One JSON line. The HEADLINE value is the deterministic
+    structural ratio `static_decode_slot_steps / decode_slot_steps` —
+    decode slot-steps each side burns (the engine stops paying for
+    retired/ragged sequences; the static sampler decodes the trace max
+    for every batch), identical on every host. Wall-clock tokens/s and
+    `vs_static` are reported as the MEDIAN over `repeats` timed runs per
+    side (both sides compile-warm: a 2-request mini-trace warms the
+    engine's two programs, one throwaway generate call warms the
+    baseline's) with the per-run walls kept in the row — on a shared CPU
+    they are load-noisy (see `wall_note`), so they sanity-check the
+    structural ratio rather than headline it. rate > 0 makes the engine
+    wall include arrival gaps; use the default rate=0 saturation trace
+    for vs_static anchors."""
+    import statistics
 
-    Both sides are timed compile-warm: a 2-request mini-trace warms the
-    engine's two programs (same static shapes as the real trace) and one
-    throwaway generate call warms the baseline's; rate > 0 makes the
-    engine wall include arrival gaps, so use the default rate=0
-    saturation trace for vs_static anchors."""
     import numpy as np
 
     from picotron_tpu.config import ModelConfig, ServeConfig, resolve_preset
@@ -390,15 +405,21 @@ def run_serve(model: str, layers, *, slots: int, block_size: int,
     warm.run([(trace[0][0], 2), (trace[1 % len(trace)][0], 2)])
     warm.close()
 
-    tel = (Telemetry(sinks=[JsonlSink(telemetry)]) if telemetry else None)
-    eng = ServeEngine(params, mcfg, scfg, telemetry=tel)
-    t0 = time.perf_counter()
-    eng.run(trace)
-    serve_wall = time.perf_counter() - t0
-    summary = eng.summary
-    eng.close()
-    if tel is not None:
-        tel.close()
+    repeats = max(repeats, 1)
+    serve_walls, summary = [], None
+    for rep in range(repeats):
+        # telemetry on the first repeat only: one stream per bench row
+        tel = (Telemetry(sinks=[JsonlSink(telemetry)])
+               if telemetry and rep == 0 else None)
+        eng = ServeEngine(params, mcfg, scfg, telemetry=tel)
+        t0 = time.perf_counter()
+        eng.run(trace)
+        serve_walls.append(time.perf_counter() - t0)
+        summary = summary or eng.summary  # identical across repeats
+        eng.close()
+        if tel is not None:
+            tel.close()
+    serve_wall = statistics.median(serve_walls)
 
     # batch-static baseline: ceil(N/slots) generate() batches in arrival
     # order, every prompt right-padded to the trace max and every batch
@@ -415,22 +436,36 @@ def run_serve(model: str, layers, *, slots: int, block_size: int,
         return jnp.asarray(ids)
 
     np.asarray(generate(params, mcfg, static_batch(groups[0]), o_max))
-    t0 = time.perf_counter()
-    for g in groups:
-        np.asarray(generate(params, mcfg, static_batch(g), o_max))
-    static_wall = time.perf_counter() - t0
+    static_walls = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for g in groups:
+            np.asarray(generate(params, mcfg, static_batch(g), o_max))
+        static_walls.append(time.perf_counter() - t0)
+    static_wall = statistics.median(static_walls)
 
     serve_tps = useful_tokens / serve_wall
     static_tps = useful_tokens / static_wall
+    slot_steps = summary["decode_steps"] * decode_interval
+    static_slot_steps = len(groups) * o_max
+    print(f"# {_WALL_NOTE}", file=sys.stderr)
     tp_tag = f"-tp{tp}" if tp > 1 else ""
     ms = lambda v: round(v * 1e3, 2) if v is not None else None  # noqa: E731
     return {
         "metric": f"serve_{model.split('/')[-1]}"
                   f"-{mcfg.num_hidden_layers}L{tp_tag}",
-        "value": round(serve_tps, 1),
-        "unit": "serve_tokens_per_sec",
+        # headline = the structural decode-work ratio, deterministic on
+        # any host; > 1 means continuous batching did strictly less
+        # slot-step work than the batch-static sampler on this trace
+        "value": round(static_slot_steps / max(slot_steps, 1), 3),
+        "unit": "static_over_serve_decode_slot_steps",
+        "serve_tokens_per_sec": round(serve_tps, 1),
         "vs_static": round(serve_tps / static_tps, 3),
         "static_tokens_per_sec": round(static_tps, 1),
+        "wall_repeats": repeats,
+        "serve_walls_s": [round(w, 4) for w in serve_walls],
+        "static_walls_s": [round(w, 4) for w in static_walls],
+        "wall_note": _WALL_NOTE,
         "requests": n_requests,
         "arrival_rate": rate,
         "useful_tokens": useful_tokens,
@@ -452,13 +487,197 @@ def run_serve(model: str, layers, *, slots: int, block_size: int,
         "preemptions": summary["preemptions"],
         "decode_steps": summary["decode_steps"],
         "decode_compiles": summary["decode_compiles"],
-        # structural comparison, independent of host-load noise: decode
-        # steps each side burns per slot (the engine stops paying for
-        # retired/ragged sequences; the static sampler decodes the trace
-        # max for every batch) — continuous batching must be strictly
-        # lower on any ragged trace
-        "decode_slot_steps": summary["decode_steps"] * decode_interval,
-        "static_decode_slot_steps": len(groups) * o_max,
+        # the raw slot-step counts behind the headline ratio — continuous
+        # batching must be strictly lower on any ragged trace
+        "decode_slot_steps": slot_steps,
+        "static_decode_slot_steps": static_slot_steps,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
+def make_burst_trace(slots: int, prompt_len: int, prefill_chunk: int,
+                     decode_interval: int, max_new: int, vocab: int,
+                     seed: int = 0) -> list:
+    """Deterministic long-prefill burst (everything arrives at t=0):
+    `slots` SHORT requests (tiny prompt, a decode budget sized to keep
+    the decode side busy for the whole long-prefill grind) followed by
+    `slots` LONG requests (full `prompt_len` prompt, small budget).
+
+    A colocated engine admits the shorts into every slot; the longs are
+    stuck in the queue until the shorts RETIRE (admission is coupled to
+    decode slots), and when they do, every slot flips to chunked prefill
+    at once — max consecutive decode-dispatch stalls ~= the long
+    prefill's ceil(prompt_len / prefill_chunk) ticks. A disaggregated
+    engine admits the longs into the PREFILL pool immediately, so their
+    prefill overlaps the shorts' decode and the handoff lands on an
+    already-warm decode pool — the stall streak collapses. That stall
+    drop is the bench headline."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    prefill_ticks = -(-prompt_len // prefill_chunk)
+    short_len = max(prompt_len // 8, 2)
+    # outlast the long prefill by a few dispatches so the decode pool is
+    # never the reason the longs look stall-free
+    short_budget = min(decode_interval * (prefill_ticks + 4), max_new)
+    long_budget = max(max_new // 8, decode_interval)
+    out = []
+    for _ in range(slots):
+        prompt = rng.integers(0, vocab, size=short_len).tolist()
+        out.append((prompt, short_budget, 0.0))
+    for _ in range(slots):
+        prompt = rng.integers(0, vocab, size=prompt_len).tolist()
+        out.append((prompt, long_budget, 0.0))
+    return out
+
+
+def run_serve_disagg(model: str, layers, *, slots: int, block_size: int,
+                     num_blocks: int, prefill_chunk: int, prompt_len: int,
+                     max_new: int, n_requests: int, rate: float,
+                     decode_interval: int = 4, seed: int = 0,
+                     draft_lens=(1, 2, 3),
+                     telemetry: str | None = None) -> dict:
+    """Disaggregated vs colocated serving (picotron_tpu/serve/disagg) on
+    the deterministic long-prefill burst trace, plus two sweep
+    artifacts. One JSON line:
+
+    - headline: the drop in max consecutive decode-dispatch stall ticks
+      (colocated minus disagg) on the burst trace — the number
+      disaggregation exists to buy, and fully deterministic.
+    - `slo_curve`: per arrival rate (derived from --rate, 0 = the
+      saturation point only), TTFT/TPOT/queue-wait percentiles for both
+      engines on the SAME Poisson trace — the
+      disaggregated-vs-colocated SLO comparison.
+    - `acceptance_sweep`: the n-gram speculator over `draft_lens` on the
+      mixed saturation trace: acceptance rate, decode dispatches, and
+      draft accounting per draft length (speculative decode is
+      token-identical to non-speculative by construction, so this is
+      pure work-per-dispatch accounting, not a quality trade).
+
+    Stall ticks, slot-steps, handoffs, and acceptance are structural —
+    identical on any host; only the secondary wall fields are timing
+    (see `wall_note`)."""
+    from picotron_tpu.analysis.cost_model import CostModel
+    from picotron_tpu.config import ModelConfig, ServeConfig, resolve_preset
+    from picotron_tpu.models.llama import init_params
+    from picotron_tpu.serve import DisaggServeEngine, ServeEngine
+    from picotron_tpu.telemetry import JsonlSink, Telemetry
+
+    cap = prompt_len + max_new
+    preset = resolve_preset(model)
+    preset["max_position_embeddings"] = max(
+        preset.get("max_position_embeddings", 0), cap)
+    if layers:
+        preset["num_hidden_layers"] = layers
+    mcfg = ModelConfig(name=model, **preset)
+    params = jax.jit(
+        lambda k: jax.tree.map(lambda x: x.astype(jnp.bfloat16),
+                               init_params(mcfg, k)))(jax.random.key(0))
+
+    def scfg(**kw):
+        base = dict(decode_slots=slots, block_size=block_size,
+                    num_blocks=num_blocks, prefill_chunk=prefill_chunk,
+                    max_model_len=cap, decode_interval=decode_interval)
+        base.update(kw)
+        return ServeConfig(**base)
+
+    def run(engine_cls, cfg, trace, tel=None):
+        eng = engine_cls(params, mcfg, cfg, telemetry=tel)
+        t0 = time.perf_counter()
+        eng.run(trace)
+        wall = time.perf_counter() - t0
+        summary = eng.summary
+        eng.close()
+        return summary, wall
+
+    # --- burst headline: stall ticks colocated vs disagg -------------
+    burst = make_burst_trace(slots, prompt_len, prefill_chunk,
+                             decode_interval, max_new, mcfg.vocab_size,
+                             seed)
+    # compile-warm both engines' programs on a 2-request mini-trace
+    for cls, cfg in ((ServeEngine, scfg()),
+                     (DisaggServeEngine, scfg(disagg=True))):
+        warm = cls(params, mcfg, cfg)
+        warm.run([(burst[0][0], 2), (burst[1][0], 2)])
+        warm.close()
+
+    tel = (Telemetry(sinks=[JsonlSink(telemetry)]) if telemetry else None)
+    colo, colo_wall = run(ServeEngine, scfg(), burst)
+    dis, dis_wall = run(DisaggServeEngine, scfg(disagg=True), burst, tel)
+    if tel is not None:
+        tel.close()
+
+    # --- SLO curve: both engines on the same Poisson trace -----------
+    base_rate = rate if rate > 0 else 0.0
+    rates = ([base_rate * f for f in (0.5, 1.0, 2.0)]
+             if base_rate > 0 else [0.0])
+    slo_curve = []
+    ms = lambda v: round(v * 1e3, 2) if v is not None else None  # noqa: E731
+    for r in rates:
+        trace = make_serve_trace(n_requests, r, prompt_len, max_new,
+                                 mcfg.vocab_size, seed)
+        point: dict = {"rate": round(r, 3), "requests": n_requests}
+        for tag, cls, cfg in (("colocated", ServeEngine, scfg()),
+                              ("disagg", DisaggServeEngine,
+                               scfg(disagg=True))):
+            s, _ = run(cls, cfg, trace)
+            point[tag] = {
+                "ttft_p50_ms": ms(s["ttft_p50_s"]),
+                "ttft_p95_ms": ms(s["ttft_p95_s"]),
+                "tpot_p50_ms": ms(s["tpot_p50_s"]),
+                "tpot_p95_ms": ms(s["tpot_p95_s"]),
+                "queue_wait_p95_ms": ms(s["queue_wait_p95_s"]),
+                "decode_stall_ticks_max": s["decode_stall_ticks_max"],
+            }
+        slo_curve.append(point)
+
+    # --- acceptance-rate sweep: n-gram speculator per draft length ---
+    sweep_trace = make_serve_trace(n_requests, 0.0, prompt_len, max_new,
+                                   mcfg.vocab_size, seed)
+    acceptance_sweep = []
+    for dl in draft_lens:
+        s, _ = run(DisaggServeEngine,
+                   scfg(disagg=True, speculator="ngram", draft_len=dl),
+                   sweep_trace)
+        acceptance_sweep.append({
+            "draft_len": dl,
+            "acceptance_rate": s["acceptance_rate"],
+            "draft_tokens": s["draft_tokens"],
+            "accepted_draft_tokens": s["accepted_draft_tokens"],
+            "decode_steps": s["decode_steps"],
+            "output_tokens": s["output_tokens"],
+        })
+
+    handoff_s, handoff_bytes = CostModel("v5e").price_kv_handoff(
+        mcfg, scfg(disagg=True))
+    print(f"# {_WALL_NOTE}", file=sys.stderr)
+    return {
+        "metric": f"serve_disagg_{model.split('/')[-1]}"
+                  f"-{mcfg.num_hidden_layers}L",
+        # headline: deterministic stall-streak drop on the burst trace
+        "value": (colo["decode_stall_ticks_max"]
+                  - dis["decode_stall_ticks_max"]),
+        "unit": "decode_stall_ticks_drop",
+        "colocated_stall_ticks_max": colo["decode_stall_ticks_max"],
+        "disagg_stall_ticks_max": dis["decode_stall_ticks_max"],
+        "burst_requests": len(burst),
+        "prompt_len": prompt_len,
+        "max_new": max_new,
+        "slots": slots,
+        "prefill_slots": dis["prefill_slots"],
+        "handoffs": dis["handoffs"],
+        "handoff_blocks": dis["handoff_blocks"],
+        "handoff_s": dis["handoff_s"],
+        "predicted_handoff_ms_worstcase": round(handoff_s * 1e3, 3),
+        "predicted_handoff_bytes_worstcase": handoff_bytes,
+        "prefill_slot_occupancy": dis["prefill_slot_occupancy"],
+        "decode_compiles": dis["decode_compiles"],
+        "preemptions": dis["preemptions"],
+        "colocated_wall_s": round(colo_wall, 4),
+        "disagg_wall_s": round(dis_wall, 4),
+        "wall_note": _WALL_NOTE,
+        "slo_curve": slo_curve,
+        "acceptance_sweep": acceptance_sweep,
         "device_kind": jax.devices()[0].device_kind,
     }
 
@@ -777,6 +996,19 @@ def main() -> None:
                     help="--serve: decode steps scanned inside one "
                          "dispatch (amortizes host overhead; retirement "
                          "latency quantizes to it)")
+    ap.add_argument("--serve-repeats", type=int, default=3,
+                    help="--serve: timed wall-clock runs per side; the "
+                         "reported wall is the median (the structural "
+                         "decode_slot_steps headline needs one run)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="--serve: disaggregated vs colocated engines "
+                         "(picotron_tpu/serve/disagg) — deterministic "
+                         "decode-stall drop on a long-prefill burst "
+                         "trace, a --rate SLO curve for both engines, "
+                         "and an n-gram speculator acceptance sweep")
+    ap.add_argument("--draft-lens", type=int, nargs="*", default=[1, 2, 3],
+                    help="--serve --disagg: speculator draft lengths "
+                         "for the acceptance sweep")
     ap.add_argument("--pp-tick-sweep", action="store_true",
                     help="fit step time vs n_micro per pipeline executor "
                          "(SPMD lockstep scan vs MPMD per-stage programs) "
@@ -842,6 +1074,21 @@ def main() -> None:
         if args.max_new_tokens < 1 or args.requests < 2:
             ap.error("--serve needs --max-new-tokens >= 1 and "
                      "--requests >= 2")
+        if args.disagg:
+            if args.tp > 1:
+                ap.error("--disagg places each pool on its own device; "
+                         "incompatible with --tp (the mesh-sharded path "
+                         "colocates the pools on the mesh)")
+            print(json.dumps(run_serve_disagg(
+                args.model, args.layers or 0, slots=args.serve_slots,
+                block_size=args.block_size, num_blocks=args.num_blocks,
+                prefill_chunk=args.prefill_chunk,
+                prompt_len=args.prompt_len,
+                max_new=args.max_new_tokens, n_requests=args.requests,
+                rate=args.rate, decode_interval=args.decode_interval,
+                draft_lens=tuple(args.draft_lens),
+                telemetry=args.telemetry)))
+            return
         print(json.dumps(run_serve(
             args.model, args.layers or 0, slots=args.serve_slots,
             block_size=args.block_size, num_blocks=args.num_blocks,
@@ -849,6 +1096,7 @@ def main() -> None:
             max_new=args.max_new_tokens, n_requests=args.requests,
             rate=args.rate, tp=args.tp,
             decode_interval=args.decode_interval,
+            repeats=args.serve_repeats,
             telemetry=args.telemetry)))
         return
 

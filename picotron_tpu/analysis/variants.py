@@ -379,6 +379,99 @@ def prove_serve_programs(model_cfg, serve_cfg=None, *, params=None) -> \
     return rep
 
 
+def prove_disagg_programs(model_cfg, serve_cfg=None) -> Report:
+    """Static proof for the DISAGGREGATED engine's four device programs
+    (serve/disagg.py): the prefill-pool chunk program, the decode-pool
+    step program, and the two handoff programs (block gather on the
+    prefill placement, sentinel-drop scatter on the decode placement).
+
+    Same argument as prove_serve_programs, per pool: every abstract
+    shape is a pure function of (model_cfg, serve_cfg) — pool sizes,
+    slot counts, and the fixed [max_blocks] handoff index width are
+    config constants, while request identity, positions, block tables,
+    and the handoff's actual block ids are DATA. One signature per
+    program => each pool compiles exactly once per engine lifetime, so
+    a prefill burst cannot trigger a decode-side recompile (nor vice
+    versa). With `speculator = "ngram"` the decode-pool program is the
+    speculative scan; its ctx buffer is [S, CTX_W] with CTX_W constant,
+    so the closure argument is unchanged."""
+    import jax.numpy as jnp
+
+    from picotron_tpu.config import ServeConfig
+    from picotron_tpu.serve.paged_cache import init_paged_cache
+    from picotron_tpu.serve.scheduler import blocks_for
+
+    scfg = serve_cfg or ServeConfig()
+    scfg.validate()
+    if model_cfg.num_experts:
+        raise ValueError(
+            "disaggregated serving rejects MoE models (chunked-prefill "
+            "expert routing is not parity-guaranteed)")
+    rep = Report()
+    max_len = scfg.max_model_len or model_cfg.max_position_embeddings
+    max_blocks = blocks_for(max_len, scfg.block_size)
+    s = scfg.decode_slots
+    p = scfg.prefill_slots or s
+    num_blocks = scfg.num_blocks or s * max_blocks
+    pnum_blocks = scfg.prefill_num_blocks or p * max_blocks
+
+    dcache = jax.eval_shape(lambda: init_paged_cache(
+        model_cfg, num_blocks, scfg.block_size, s, max_blocks))
+    pcache = jax.eval_shape(lambda: init_paged_cache(
+        model_cfg, pnum_blocks, scfg.block_size, p, max_blocks))
+    i32 = lambda *shape: jax.ShapeDtypeStruct(shape, jnp.int32)  # noqa: E731
+    sds = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa: E731
+
+    decode_args = {
+        "k": sds(dcache.k), "v": sds(dcache.v),
+        "tables": i32(s, max_blocks), "toks": i32(s),
+        "positions": i32(s), "rids": i32(s), "tidx": i32(s),
+    }
+    if scfg.speculator == "ngram":
+        from picotron_tpu.serve.spec_decode import CTX_W
+
+        decode_args["ctx"] = i32(s, CTX_W)
+    prefill_args = {
+        "k": sds(pcache.k), "v": sds(pcache.v),
+        "tables": i32(p, max_blocks),
+        "chunk_ids": i32(p, scfg.prefill_chunk),
+        "start_pos": i32(p), "n_valid": i32(p), "rids": i32(p),
+        "tidx": i32(p),
+    }
+    # handoff: gather pulls [max_blocks] block rows from the prefill
+    # pool; scatter writes the staged buffer into the decode pool.
+    # Index vectors are padded to the constant max_blocks width exactly
+    # so a request's block COUNT stays data, not shape.
+    buf = jax.ShapeDtypeStruct(
+        (pcache.k.shape[0], max_blocks) + tuple(pcache.k.shape[2:]),
+        pcache.k.dtype)
+    gather_args = {"k": sds(pcache.k), "v": sds(pcache.v),
+                   "idx": i32(max_blocks)}
+    scatter_args = {"k": sds(dcache.k), "v": sds(dcache.v),
+                    "buf_k": buf, "buf_v": buf, "idx": i32(max_blocks)}
+
+    sigs = {
+        "prefill_pool": signature_of(prefill_args),
+        "decode_pool": signature_of(decode_args),
+        "handoff_gather": signature_of(gather_args),
+        "handoff_scatter": signature_of(scatter_args),
+    }
+    rep.info[CHECK] = {
+        "entry": "serve_disagg",
+        "programs": len(sigs),
+        "signatures": {name: len(sig.leaves) for name, sig in sigs.items()},
+        "proven": True,
+        "prefill_slots": p, "decode_slots": s,
+        "speculator": scfg.speculator,
+    }
+    rep.add(CHECK, INFO, "serve_disagg",
+            f"compile-once proven for both pools + handoff: "
+            f"{len(sigs)} programs, one closed abstract signature each "
+            f"(prefill [{p}, {scfg.prefill_chunk}], decode [{s}], "
+            f"handoff idx [{max_blocks}])")
+    return rep
+
+
 # ---------------------------------------------------------------------------
 # The check (runner wiring)
 # ---------------------------------------------------------------------------
@@ -402,5 +495,11 @@ def audit_variants(cfg, *, low=None, menv=None) -> Report:
         info["serve"] = serve_rep.info.get(CHECK, {})
     except Exception as e:  # serve stack optional for exotic models
         info["serve"] = {"unavailable": f"{type(e).__name__}: {e}"}
+    try:
+        disagg_rep = prove_disagg_programs(cfg.model, cfg.serve)
+        rep.findings.extend(disagg_rep.findings)
+        info["serve_disagg"] = disagg_rep.info.get(CHECK, {})
+    except Exception as e:  # e.g. MoE models: disagg serving rejects them
+        info["serve_disagg"] = {"unavailable": f"{type(e).__name__}: {e}"}
     rep.info[CHECK] = info
     return rep

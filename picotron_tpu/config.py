@@ -692,6 +692,36 @@ class ServeConfig:
     # scheduling.
     decode_interval: int = 4
 
+    # --- disaggregated serving (serve/disagg.py): prefill pool / decode
+    # pool as separately placed programs with paged-KV block handoff, so
+    # a burst of long prefills cannot stall decode dispatches ---
+    # Split prefill and decode into two pools (DisaggServeEngine).
+    disagg: bool = False
+    # Prefill-pool slot width (the prefill program's static batch shape).
+    # 0 = decode_slots.
+    prefill_slots: int = 0
+    # Physical blocks in the prefill pool. 0 = auto: prefill_slots *
+    # ceil(max_model_len / block_size) — every prefill slot can hold a
+    # full-length prompt without backpressure.
+    prefill_num_blocks: int = 0
+    # Device placement per pool: an index into jax.devices(). -1 = auto
+    # (prefill on the first device, decode on the last — distinct devices
+    # whenever the host has more than one, colocated otherwise).
+    prefill_device: int = -1
+    decode_device: int = -1
+
+    # --- speculative decode (serve/spec_decode.py): multi-token decode
+    # inside the decode_interval scan, verify-and-accept in one dispatch,
+    # sampling keys still derived from (request id, token index) so
+    # accept/reject cannot perturb tokens ---
+    # 'off' (one token per slot per step) or 'ngram' (self-drafting
+    # n-gram speculator over each slot's recent context — no draft
+    # model, the prompt-lookup arrangement).
+    speculator: str = "off"
+    # Tokens drafted (and verified) per decode step when the speculator
+    # is on; each step emits 1..draft_len+1 tokens per slot.
+    draft_len: int = 3
+
     def validate(self) -> None:
         for name in ("decode_slots", "block_size", "prefill_chunk",
                      "decode_interval"):
@@ -706,6 +736,27 @@ class ServeConfig:
             raise ValueError(
                 f"serve.max_model_len must be >= 0 (0 = model limit), got "
                 f"{self.max_model_len}")
+        for name in ("prefill_slots", "prefill_num_blocks"):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"serve.{name} must be >= 0 (0 = auto), got "
+                    f"{getattr(self, name)}")
+        for name in ("prefill_device", "decode_device"):
+            if getattr(self, name) < -1:
+                raise ValueError(
+                    f"serve.{name} must be a device index or -1 (auto), "
+                    f"got {getattr(self, name)}")
+        if self.speculator not in ("off", "ngram"):
+            raise ValueError(
+                f"serve.speculator must be 'off' or 'ngram', got "
+                f"{self.speculator!r}")
+        if self.speculator != "off" and self.draft_len < 1:
+            raise ValueError(
+                f"serve.draft_len must be >= 1 when a speculator is on, "
+                f"got {self.draft_len}")
+        if self.draft_len < 0:
+            raise ValueError(
+                f"serve.draft_len must be >= 0, got {self.draft_len}")
 
 
 @dataclass(frozen=True)
@@ -816,6 +867,21 @@ class Config:
                 f"serve.max_model_len ({self.serve.max_model_len}) exceeds "
                 f"max_position_embeddings "
                 f"({self.model.max_position_embeddings})")
+        if self.model.num_experts and (self.serve.disagg
+                                       or self.serve.speculator != "off"):
+            # The serving engines chunk every prefill, and MoE routing is
+            # capacity-bounded PER CALL: the same prompt split into chunks
+            # routes (and drops) tokens differently than one batched pass,
+            # so chunked prefill is not parity-guaranteed for MoE (the
+            # PR-7 KNOWN issue, now a hard error instead of a footnote).
+            # The engines themselves reject MoE at construction; this
+            # catches the intent at config load.
+            raise ValueError(
+                "serve.disagg / serve.speculator do not support MoE "
+                "models (model.num_experts > 0): chunked prefill routes "
+                "tokens through per-call capacity-bounded expert dispatch, "
+                "which is not parity-guaranteed against the offline "
+                "sampler; serve dense models only")
         d, m, t = self.distributed, self.model, self.training
         ck = self.checkpoint
         if ck.keep_last < 0 or ck.keep_every < 0:

@@ -6,25 +6,35 @@ path — the "millions of users, heavy traffic" half of the north star.
   same interface as the offline contiguous `generate.KVCache`.
 - `scheduler`: FIFO admission into a fixed decode-slot batch, chunked
   prefill, youngest-first preemption with recompute, retirement — pure
-  host logic.
-- `engine`: the driver — two jitted device programs (one decode step,
-  one prefill chunk; each compiled exactly once per serving lifetime)
-  plus telemetry (queue_wait/prefill/decode in the GoodputLedger, TTFT /
-  per-token latency histograms, serve_request/serve_summary JSONL).
-
-Prefill and decode are separate programs on purpose: the planned MPMD
-executor (ROADMAP) can disaggregate them across chips without touching
-this layer.
+  host logic. `DisaggScheduler` splits the slot set in two with a
+  handoff boundary between the pools.
+- `engine`: the colocated driver — two jitted device programs (one
+  decode step, one prefill chunk; each compiled exactly once per
+  serving lifetime) plus telemetry (queue_wait/prefill/decode in the
+  GoodputLedger, TTFT/TPOT/per-token latency histograms,
+  serve_request/serve_summary JSONL).
+- `disagg`: the disaggregated driver — prefill and decode as separately
+  PLACED pools over their own block pools, paged-KV block handoff via
+  explicit `device_put` (the MPMD ring-buffer discipline), so prefill
+  bursts cannot stall decode dispatches.
+- `spec_decode`: speculative multi-token decode (self-drafting n-gram
+  speculator, verify-and-accept in one dispatch) for either engine;
+  token-identical to non-speculative decode by construction.
 """
 
+from picotron_tpu.serve.disagg import DisaggServeEngine
 from picotron_tpu.serve.engine import ServeEngine
 from picotron_tpu.serve.paged_cache import (
     BlockPool, PagedKVCache, init_paged_cache,
 )
-from picotron_tpu.serve.scheduler import Request, Scheduler, blocks_for
+from picotron_tpu.serve.scheduler import (
+    DisaggScheduler, Request, Scheduler, blocks_for,
+)
 
 __all__ = [
     "BlockPool",
+    "DisaggScheduler",
+    "DisaggServeEngine",
     "PagedKVCache",
     "Request",
     "Scheduler",

@@ -29,6 +29,19 @@ Sampling keys derive from (request id, token index), so tokens are
 independent of slot assignment, arrival interleaving, and preemption —
 the ragged-batch-invariance property the tests pin.
 
+With ``serve.speculator = "ngram"`` the decode program is swapped for
+the speculative verify-and-accept scan (serve/spec_decode.py): each
+dispatch still compiles once and still covers `decode_interval`
+iterations, but every iteration forwards 1 + draft_len candidate tokens
+and emits between 1 and 1 + draft_len of them. The same key fold keys
+every candidate position, so speculative output is bit-identical to the
+non-speculative stream at any temperature — acceptance only changes how
+fast the stream advances. MoE models are rejected at engine
+construction: chunked prefill routes tokens through per-call
+capacity-bounded expert dispatch, so routing depends on the chunking
+and parity with the offline sampler cannot be guaranteed (the PR-7
+KNOWN, now a hard error).
+
 Observability rides the existing telemetry machinery: the GoodputLedger
 books queue_wait / prefill / decode (compile time drained out exactly
 via CompileWatch), per-request TTFT and per-token latency land in the
@@ -193,6 +206,14 @@ class ServeEngine:
                  telemetry: Optional[Telemetry] = None):
         scfg = serve_cfg or ServeConfig()
         scfg.validate()
+        if model_cfg.num_experts:
+            raise ValueError(
+                "serving does not support MoE models (num_experts > 0): "
+                "chunked prefill feeds each chunk through per-call "
+                "capacity-bounded expert dispatch, so routing — and "
+                "therefore tokens — depends on the chunking; parity with "
+                "the offline sampler cannot be guaranteed. Serve dense "
+                "models only.")
         self.params = params
         self.cfg = model_cfg
         self.scfg = scfg
@@ -207,6 +228,16 @@ class ServeEngine:
         self.num_blocks = (scfg.num_blocks
                            or scfg.decode_slots * self.max_blocks)
         self.num_slots = scfg.decode_slots
+
+        self.speculate = scfg.speculator == "ngram"
+        self.draft_len = scfg.draft_len if self.speculate else 0
+        if self.speculate:
+            from picotron_tpu.serve import spec_decode
+            if self.draft_len > spec_decode.max_draft_len():
+                raise ValueError(
+                    f"serve.draft_len ({self.draft_len}) exceeds the "
+                    f"drafter's context window: max "
+                    f"{spec_decode.max_draft_len()}")
 
         self.cos, self.sin = model_rope_tables(model_cfg,
                                                max_len=self.max_len)
@@ -268,6 +299,9 @@ class ServeEngine:
         self.telemetry = telemetry or Telemetry(sinks=[])
         self._decode_jit, self._prefill_jit = _get_jits(
             jax.default_backend() != "cpu")
+        if self.speculate:
+            from picotron_tpu.serve.spec_decode import get_spec_jit
+            self._decode_jit = get_spec_jit(jax.default_backend() != "cpu")
 
         self._t0 = time.perf_counter()  # trace clock zero (run() resets)
         # steady-state decode fast path: device-resident step inputs,
@@ -278,7 +312,10 @@ class ServeEngine:
             "decode_steps": 0, "decode_compiles": 0,
             "prefill_chunks": 0, "occupancy_sum": 0.0,
             "output_tokens": 0, "prefill_tokens": 0,
+            "draft_tokens": 0, "accepted_draft_tokens": 0,
+            "decode_stall_ticks_max": 0,
         }
+        self._stall_streak = 0  # consecutive ticks: work queued, no decode
         self._next_auto_id = 0
 
         # Static variant-prover check over the feed the engine just built
@@ -329,6 +366,13 @@ class ServeEngine:
         req = st.req
         ttft = (st.t_first_token - req.arrival
                 if st.t_first_token is not None else None)
+        # TPOT: mean inter-token time AFTER the first token — the decode
+        # SLO, as distinct from TTFT (the prefill/queueing SLO)
+        tpot = None
+        if st.t_first_token is not None and len(st.generated) > 1:
+            tpot = (max(now - st.t_first_token, 0.0)
+                    / (len(st.generated) - 1))
+            self.telemetry.registry.histogram("serve/tpot").observe(tpot)
         res = {
             "id": req.id,
             "prompt_len": len(req.prompt),
@@ -337,6 +381,7 @@ class ServeEngine:
             "queue_wait_s": max((st.t_admit or 0.0) - req.arrival, 0.0),
             "ttft_s": ttft,
             "latency_s": max(now - req.arrival, 0.0),
+            "tpot_s": tpot,
             "n_preempted": st.n_preempted,
         }
         self.results.append(res)
@@ -347,6 +392,7 @@ class ServeEngine:
             queue_wait_s=round(res["queue_wait_s"], 6),
             ttft_s=round(ttft, 6) if ttft is not None else None,
             latency_s=round(res["latency_s"], 6),
+            tpot_s=round(tpot, 6) if tpot is not None else None,
             preempted=st.n_preempted)
         return res
 
@@ -428,16 +474,39 @@ class ServeEngine:
             worked = True
 
         # ---- one decode step over every slot with a live sequence
+        decode_ran = self._decode_tick(now, reg)
+        worked = worked or decode_ran
+        # max consecutive ticks with work in the system but no decode
+        # dispatch — the TTFT/TPOT SLO killer the disaggregated engine
+        # exists to eliminate (bench.py --serve --disagg compares this)
+        if decode_ran:
+            self._stall_streak = 0
+        elif self.sched.has_work():
+            self._stall_streak += 1
+            self.stats["decode_stall_ticks_max"] = max(
+                self.stats["decode_stall_ticks_max"], self._stall_streak)
+        return worked
+
+    def _decode_tick(self, now: float, reg) -> bool:
+        """One decode dispatch over every decode-ready slot. Operates
+        purely through the scheduler's decode interface plus the
+        decode-side device context (self.params/_k/_v/cos/sin/base_key/
+        _rep_sh), so the disaggregated engine reuses it verbatim against
+        its decode pool. Returns whether a dispatch ran."""
         ready = self.sched.decode_ready()
         if ready:
             active = []
             dropped: set = set()
             interval = self.scfg.decode_interval
+            # a speculative iteration can advance a slot by up to
+            # 1 + draft_len positions, so the write horizon (and the
+            # block allocation backing it) scales with it
+            span = interval * (1 + self.draft_len)
             for s in ready:
                 if s in dropped:
                     continue
                 st = self.sched.slots[s]
-                horizon = min(interval,
+                horizon = min(span,
                               st.req.max_new_tokens - len(st.generated))
                 n_before = len(st.blocks)
                 ok, preempted = self.sched.ensure_block(s, horizon)
@@ -474,22 +543,46 @@ class ServeEngine:
                           "positions": up(positions),
                           "rids": up(rids),
                           "tidx": up(tidx)}
+                    if self.speculate:
+                        from picotron_tpu.serve.spec_decode import (
+                            context_rows,
+                        )
+                        ds["ctx"] = up(context_rows(
+                            self.sched.slots, active, self.num_slots))
                 self._drain_compile()
                 t0 = time.perf_counter()
-                toks_d, last_d, pos_d, tidx_d, self._k, self._v = \
-                    self._decode_jit(
+                nval = None
+                if self.speculate:
+                    (toks_d, nval_d, last_d, pos_d, tidx_d, ctx_d,
+                     self._k, self._v) = self._decode_jit(
                         self.params, self._k, self._v,
                         ds["tables"], ds["toks"], ds["positions"],
-                        ds["rids"], ds["tidx"], self.base_key, self.cos,
-                        self.sin, cfg=self.cfg,
+                        ds["rids"], ds["tidx"], ds["ctx"], self.base_key,
+                        self.cos, self.sin, cfg=self.cfg,
                         temperature=self.temperature, top_k=self.top_k,
                         interval=interval,
-                        eos_token_id=self.eos_token_id)
-                nxt = np.asarray(toks_d)  # [S, interval]
+                        eos_token_id=self.eos_token_id,
+                        draft_len=self.draft_len)
+                    nxt = np.asarray(toks_d)   # [S, interval, 1+d]
+                    nval = np.asarray(nval_d)  # [S, interval]
+                    state = dict(ds, toks=last_d, positions=pos_d,
+                                 tidx=tidx_d, ctx=ctx_d)
+                else:
+                    toks_d, last_d, pos_d, tidx_d, self._k, self._v = \
+                        self._decode_jit(
+                            self.params, self._k, self._v,
+                            ds["tables"], ds["toks"], ds["positions"],
+                            ds["rids"], ds["tidx"], self.base_key,
+                            self.cos, self.sin, cfg=self.cfg,
+                            temperature=self.temperature,
+                            top_k=self.top_k, interval=interval,
+                            eos_token_id=self.eos_token_id)
+                    nxt = np.asarray(toks_d)  # [S, interval]
+                    state = dict(ds, toks=last_d, positions=pos_d,
+                                 tidx=tidx_d)
                 # feed outputs forward; any roster/table change below
                 # nulls this via _sync_table
-                self._decode_state = dict(ds, toks=last_d, positions=pos_d,
-                                          tidx=tidx_d)
+                self._decode_state = state
                 dt = time.perf_counter() - t0
                 csecs = self._drain_compile()
                 if csecs:
@@ -498,20 +591,35 @@ class ServeEngine:
                 n_tokens = 0
                 for s in active:
                     st = self.sched.slots[s]
+                    retired = False
                     for t in range(interval):
-                        st.generated.append(int(nxt[s, t]))
-                        n_tokens += 1
-                        if self.sched.should_retire(s, self.eos_token_id):
-                            # interval tokens past EOS/budget are padding
-                            st = self.sched.retire(s)
-                            self._sync_table(s)
-                            self._emit_retired(st, now + dt)
+                        if retired:
                             break
+                        if self.speculate:
+                            emit = [int(x)
+                                    for x in nxt[s, t, :int(nval[s, t])]]
+                            self.stats["draft_tokens"] += self.draft_len
+                            self.stats["accepted_draft_tokens"] += (
+                                len(emit) - 1)
+                        else:
+                            emit = [int(nxt[s, t])]
+                        for tok in emit:
+                            st.generated.append(tok)
+                            n_tokens += 1
+                            if self.sched.should_retire(
+                                    s, self.eos_token_id):
+                                # tokens past EOS/budget are padding
+                                rst = self.sched.retire(s)
+                                self._sync_table(s)
+                                self._emit_retired(rst, now + dt)
+                                retired = True
+                                break
                 self.telemetry.emit("phase", phase="decode",
                                     category="decode", secs=dt,
                                     tokens=n_tokens)
                 reg.histogram("serve/token_latency").observe(
-                    dt / max(len(active) * interval, 1))
+                    dt / max(n_tokens if self.speculate
+                             else len(active) * interval, 1))
                 self.stats["decode_steps"] += 1
                 self.stats["occupancy_sum"] += len(active) / self.num_slots
                 self.stats["output_tokens"] += n_tokens
@@ -519,8 +627,8 @@ class ServeEngine:
                     len(active) / self.num_slots)
                 reg.gauge("serve/pool_utilization").set(
                     self.pool.in_use / self.num_blocks)
-                worked = True
-        return worked
+                return True
+        return False
 
     # -- trace driver ------------------------------------------------------
 
@@ -546,12 +654,18 @@ class ServeEngine:
         return sorted(self.results, key=lambda r: r["id"])
 
     def _emit_summary(self, wall: float) -> None:
+        self.summary = self._summary_dict(wall)
+        self.telemetry.emit("serve_summary", **self.summary)
+
+    def _summary_dict(self, wall: float) -> dict:
         reg = self.telemetry.registry
         ttft = reg.histogram("serve/ttft")
         lat = reg.histogram("serve/token_latency")
         qw = reg.histogram("serve/queue_wait")
+        tpot = reg.histogram("serve/tpot")
         steps = max(self.stats["decode_steps"], 1)
-        self.summary = {
+        drafted = self.stats["draft_tokens"]
+        return {
             "requests": len(self.results),
             "output_tokens": sum(r["output_tokens"] for r in self.results),
             "wall_s": round(wall, 6),
@@ -560,6 +674,7 @@ class ServeEngine:
                 / max(wall, 1e-9), 2),
             "ttft_p50_s": ttft.p50, "ttft_p95_s": ttft.p95,
             "token_latency_p50_s": lat.p50, "token_latency_p95_s": lat.p95,
+            "tpot_p50_s": tpot.p50, "tpot_p95_s": tpot.p95,
             "queue_wait_p50_s": qw.p50, "queue_wait_p95_s": qw.p95,
             "slot_occupancy": round(self.stats["occupancy_sum"] / steps, 4),
             "pool_peak_utilization": round(
@@ -567,12 +682,20 @@ class ServeEngine:
             "decode_steps": self.stats["decode_steps"],
             "decode_compiles": self.stats["decode_compiles"],
             "prefill_chunks": self.stats["prefill_chunks"],
+            "decode_stall_ticks_max":
+                self.stats["decode_stall_ticks_max"],
+            "speculator": self.scfg.speculator,
+            "draft_len": self.draft_len,
+            "draft_tokens": drafted,
+            "accepted_draft_tokens": self.stats["accepted_draft_tokens"],
+            "acceptance_rate": (
+                round(self.stats["accepted_draft_tokens"] / drafted, 4)
+                if drafted else None),
             "preemptions": self.sched.n_preempted,
             "slots": self.num_slots,
             "num_blocks": self.num_blocks,
             "block_size": self.block_size,
         }
-        self.telemetry.emit("serve_summary", **self.summary)
 
     def close(self) -> None:
         if self._owns_telemetry:

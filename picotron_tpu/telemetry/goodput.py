@@ -35,6 +35,11 @@ Every timed second of the run is booked to exactly one category:
                      (picotron_tpu/serve): the engine's two jitted
                      programs (both goodput — tokens leaving the system)
                      and time requests sat queued before admission.
+- ``handoff``      — disaggregated serving only (serve/disagg.py): the
+                     prefill->decode KV-block transfer across the pool
+                     boundary. Transport overhead, NOT goodput — the
+                     number the cost model's price_kv_handoff predicts
+                     and the decode pool must never wait on.
 
 The per-phase -> category mapping is shared with tools/telemetry_report.py
 (PHASE_CATEGORY) so in-process booking and post-hoc JSONL analysis can
@@ -77,8 +82,9 @@ CATEGORIES = (
     "retry_backoff", "data_wait", "host_sync", "pp_bubble", "eval",
     "other",
     # serving (picotron_tpu/serve): device time in the two jitted
-    # programs (goodput) and the admission-latency badput
-    "prefill", "decode", "queue_wait",
+    # programs (goodput), the admission-latency badput, and the
+    # disaggregated engines' cross-pool KV transfer (badput: transport)
+    "prefill", "decode", "queue_wait", "handoff",
 )
 
 

@@ -416,22 +416,24 @@ def _bench_serve_row(**kw):
 
     args = dict(slots=4, block_size=8, num_blocks=0, prefill_chunk=32,
                 prompt_len=32, max_new=96, n_requests=24, rate=0.0,
-                decode_interval=6, seed=0)
+                decode_interval=6, seed=0, repeats=1)
     args.update(kw)
     return bench.run_serve("debug-tiny", 4, **args)
 
 
 def test_bench_serve_structural_beats_static():
-    """Continuous batching on a mixed-length trace must burn strictly
-    fewer decode slot-steps than the batch-static sampler (which decodes
-    the trace max for every batch) — the deterministic half of the
-    tokens/s comparison, immune to host-load noise. The wall-clock
-    tokens/s ratio is sanity-bounded here and asserted > 1 in the slow
-    tier (test_bench_serve_beats_static_wall_clock); PERF.md documents
-    the on-hardware protocol."""
+    """The HEADLINE metric is now the deterministic structural ratio
+    static_decode_slot_steps / decode_slot_steps — continuous batching
+    on a mixed-length trace must burn strictly fewer decode slot-steps
+    than the batch-static sampler (which decodes the trace max for every
+    batch), identically on every host. Wall-clock is demoted to a
+    median-of-repeats sanity field carrying the CPU noise caveat (the
+    KNOWN 0.85-1.19 swing is load noise, not a result)."""
     row = _bench_serve_row(n_requests=12, max_new=48)
-    assert row["unit"] == "serve_tokens_per_sec" and row["value"] > 0
+    assert row["unit"] == "static_over_serve_decode_slot_steps"
+    assert row["value"] > 1.0  # structural win, deterministic
     assert row["decode_slot_steps"] < row["static_decode_slot_steps"]
+    assert row["serve_tokens_per_sec"] > 0
     # the ratio is the structural win; wall-clock realizes it modulo
     # dispatch overhead + host noise (10-20x swings documented on this
     # host, PERF.md r4) — bound it loosely rather than flakily
@@ -439,24 +441,29 @@ def test_bench_serve_structural_beats_static():
     assert row["decode_compiles"] == 0  # warmed by the warm-trace engine
     assert row["ttft_p50_ms"] is not None
     assert row["preemptions"] == 0
+    assert "noisy" in row["wall_note"]
+    assert len(row["serve_walls_s"]) == row["wall_repeats"] == 1
 
 
 @pytest.mark.slow
 def test_bench_serve_wall_clock_vs_static():
-    """Wall-clock tokens/s vs the static sampler, best-of-3 against
-    host-load noise (the max-over-attempts idiom bench --sweep uses,
-    ADVICE r4). At debug-tiny scale on a shared CPU the per-dispatch
-    penalty (~1.3x a monolithic-scan step) roughly cancels the
-    structural step win, so observed ratios sit at parity, 0.9-1.2
-    across repeated runs (PERF.md r7) — the assert pins "no dispatch
-    regression" (>0.85) plus the deterministic >=1.4x structural step
-    ratio; the unambiguous wall-clock beat is the TPU protocol row in
-    PERF.md, where decode is HBM-bound and dispatch overhead is noise."""
+    """Wall-clock tokens/s vs the static sampler, median-of-repeats per
+    seed and best-of-3 seeds against host-load noise (the
+    max-over-attempts idiom bench --sweep uses, ADVICE r4). At
+    debug-tiny scale on a shared CPU the per-dispatch penalty (~1.3x a
+    monolithic-scan step) roughly cancels the structural step win, so
+    observed ratios sit at parity, 0.9-1.2 across repeated runs
+    (PERF.md r7) — the assert pins "no dispatch regression" (>0.85)
+    plus the deterministic >=1.4x structural step ratio; the
+    unambiguous wall-clock beat is the TPU protocol row in PERF.md,
+    where decode is HBM-bound and dispatch overhead is noise."""
     rows = [_bench_serve_row(n_requests=48, prompt_len=16, max_new=96,
-                             prefill_chunk=16, seed=s) for s in (0, 1, 2)]
+                             prefill_chunk=16, seed=s, repeats=3)
+            for s in (0, 1, 2)]
     best = max(r["vs_static"] for r in rows)
     assert best > 0.85, f"serve throughput regressed vs static: {best}"
     for r in rows:
+        assert len(r["serve_walls_s"]) == 3  # median-of-repeats basis
         assert (r["static_decode_slot_steps"]
                 >= 1.4 * r["decode_slot_steps"])
 

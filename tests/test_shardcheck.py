@@ -609,7 +609,8 @@ def test_shardflow_runs_gate():
         if prov["attribution_pct"] < 90.0:
             problems.append(f"{name}: attribution "
                             f"{prov['attribution_pct']}% < 90%")
-        for entry in ("train_step", "serve", "mpmd_stages"):
+        for entry in ("train_step", "serve", "serve_disagg",
+                      "mpmd_stages"):
             if (base.get(f"{entry}_proven")
                     and not var.get(entry, {}).get("proven")):
                 problems.append(f"{name}: {entry} no longer proven "

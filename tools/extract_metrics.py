@@ -98,16 +98,36 @@ _STABLE_STEP_FIELDS = {"ts", "kind", "step", "loss", "tokens_per_sec",
                        "memory_gb", "line"}
 
 
+# serve_summary fields harvested into serve_* CSV columns — the SLO
+# numbers a serving sweep compares across runs (latency seconds scaled
+# to ms to match the report tool).
+_SERVE_FIELDS = (
+    ("requests", "serve_requests", 1),
+    ("output_tokens", "serve_output_tokens", 1),
+    ("tokens_per_sec", "serve_tokens_per_sec", 1),
+    ("ttft_p50_s", "serve_ttft_p50_ms", 1e3),
+    ("ttft_p95_s", "serve_ttft_p95_ms", 1e3),
+    ("tpot_p50_s", "serve_tpot_p50_ms", 1e3),
+    ("tpot_p95_s", "serve_tpot_p95_ms", 1e3),
+    ("acceptance_rate", "serve_acceptance_rate", 1),
+    ("decode_stall_ticks_max", "serve_decode_stall_ticks_max", 1),
+    ("handoffs", "serve_handoffs", 1),
+)
+
+
 def process_telemetry(path: str, skip_steps: int = 3) -> dict | None:
     """The structured twin of process_file: per-step rows from a
     telemetry.jsonl's "step" records (same schema as the regex rows, so
     the aggregation below is shared) + the goodput % from the stream's
     (category, secs) accounting. Replayed step numbers (rollback /
     restart) keep only their LAST record — the one whose update survived
-    into the final weights."""
+    into the final weights. Serving streams (no step rows, but a
+    serve_summary event) yield serve_* columns instead, so a serving
+    sweep harvests TTFT/TPOT/acceptance with the same tool."""
     rows_by_step: dict[int, dict] = {}
     val_losses: list[float] = []
     categories: dict[str, float] = {}
+    serve_summary: dict | None = None
     with open(path) as f:
         for raw in f:
             raw = raw.strip()
@@ -123,6 +143,8 @@ def process_telemetry(path: str, skip_steps: int = 3) -> dict | None:
                     and isinstance(secs, (int, float)):
                 categories[ev["category"]] = \
                     categories.get(ev["category"], 0.0) + secs
+            if kind == "serve_summary":
+                serve_summary = ev  # last wins (mirrors telemetry_report)
             if kind == "step" and "step" in ev:
                 row = {
                     "step": int(ev["step"]),
@@ -141,9 +163,18 @@ def process_telemetry(path: str, skip_steps: int = 3) -> dict | None:
                 val_losses.append(float(ev["val_loss"]))
     rows = [r for _, r in sorted(rows_by_step.items())
             if r["step"] > skip_steps]
+    serve_cols = {}
+    if serve_summary:
+        for src, dst, scale in _SERVE_FIELDS:
+            val = serve_summary.get(src)
+            if isinstance(val, (int, float)):
+                serve_cols[dst] = round(val * scale, 4)
     if not rows:
-        return None
+        if not serve_cols:
+            return None
+        return serve_cols  # serving-only stream: no train-step rows
     out = _aggregate_rows(rows, val_losses)
+    out.update(serve_cols)
     accounted = sum(categories.values())
     if accounted > 0:
         out["goodput_pct"] = round(
@@ -234,8 +265,14 @@ def main() -> None:
             w.writerow(r)
     print(f"{len(results)} runs -> {out}")
     for r in results:
-        print(f"  {r['run']}: {r['mean_tokens_per_sec_per_chip']:.0f} tok/s/chip, "
-              f"{r['mean_mfu_pct']:.1f}% MFU, loss {r['final_loss']:.3f}")
+        if "mean_tokens_per_sec_per_chip" in r:
+            print(f"  {r['run']}: {r['mean_tokens_per_sec_per_chip']:.0f} "
+                  f"tok/s/chip, {r['mean_mfu_pct']:.1f}% MFU, "
+                  f"loss {r['final_loss']:.3f}")
+        else:  # serving-only run (serve_summary, no train steps)
+            print(f"  {r['run']}: {r.get('serve_tokens_per_sec', 0)} tok/s, "
+                  f"TTFT p50 {r.get('serve_ttft_p50_ms', 'n/a')} ms, "
+                  f"acceptance {r.get('serve_acceptance_rate', 'n/a')}")
 
 
 if __name__ == "__main__":

@@ -240,11 +240,25 @@ def serving_view(reqs: list[dict], summary: dict | None) -> dict:
                 ("tokens_per_sec", "tokens_per_sec", 1),
                 ("token_latency_p50_s", "token_latency_p50_ms", 1e3),
                 ("token_latency_p95_s", "token_latency_p95_ms", 1e3),
+                ("tpot_p50_s", "tpot_p50_ms", 1e3),
+                ("tpot_p95_s", "tpot_p95_ms", 1e3),
                 ("slot_occupancy", "slot_occupancy", 1),
                 ("pool_peak_utilization", "pool_peak_utilization", 1),
                 ("decode_steps", "decode_steps", 1),
                 ("decode_compiles", "decode_compiles", 1),
                 ("preemptions", "preemptions", 1),
+                ("decode_stall_ticks_max", "decode_stall_ticks_max", 1),
+                # disaggregated engines only (serve/disagg.py)
+                ("prefill_slot_occupancy", "prefill_slot_occupancy", 1),
+                ("prefill_pool_peak_utilization",
+                 "prefill_pool_peak_utilization", 1),
+                ("handoffs", "handoffs", 1),
+                ("handoff_s", "handoff_s", 1),
+                ("handoff_blocks", "handoff_blocks", 1),
+                # speculative decode (serve/spec_decode.py)
+                ("acceptance_rate", "acceptance_rate", 1),
+                ("draft_tokens", "draft_tokens", 1),
+                ("accepted_draft_tokens", "accepted_draft_tokens", 1),
                 ("wall_s", "wall_s", 1)):
             val = summary.get(src)
             if isinstance(val, (int, float)):
@@ -368,6 +382,23 @@ def render(s: dict, markdown: bool = False) -> str:
             f"{pair('pool_peak_utilization')} | decode steps "
             f"{pair('decode_steps')} (compiles {pair('decode_compiles')}) "
             f"| preemptions {pair('preemptions')}")
+        if "tpot_p50_ms" in sv or "decode_stall_ticks_max" in sv:
+            lines.append(
+                f"  TPOT p50 {pair('tpot_p50_ms')} ms p95 "
+                f"{pair('tpot_p95_ms')} ms | max decode stall "
+                f"{pair('decode_stall_ticks_max')} ticks")
+        if "handoffs" in sv or "prefill_slot_occupancy" in sv:
+            lines.append(
+                f"  disagg: prefill occupancy "
+                f"{pair('prefill_slot_occupancy')} (pool peak "
+                f"{pair('prefill_pool_peak_utilization')}) | handoffs "
+                f"{pair('handoffs')} ({pair('handoff_blocks')} blocks, "
+                f"{pair('handoff_s')} s)")
+        if "acceptance_rate" in sv or "draft_tokens" in sv:
+            lines.append(
+                f"  speculative: acceptance {pair('acceptance_rate')} "
+                f"({pair('accepted_draft_tokens')}/{pair('draft_tokens')} "
+                f"draft tokens accepted)")
         lines.append("")
     rz = s.get("resize")
     if rz:
