@@ -795,6 +795,99 @@ def run_pp_tick_sweep(model: str, layers, seq: int, mbs: int, *,
     return row
 
 
+def run_cp_flavor_sweep(model: str, layers, seqs, mbs: int, *,
+                        cp: int = 0, steps: int = 3,
+                        warmup: int = 1) -> list:
+    """Ring vs Ulysses vs mesh context parallelism on the same train step:
+    one JSON row per (seq, cp_flavor) with the measured step time and the
+    ICI cost model's prediction for this device kind — the instrument for
+    the PERF.md Round 13 question (where does the 2D mesh schedule's
+    crossover land on real ICI, and does the topology model's predicted
+    ordering hold?). A flavor the head counts cannot schedule (Ulysses
+    needs heads % cp == 0) reports `infeasible` instead of vanishing —
+    on GQA models that asymmetry IS the mesh flavor's reason to exist.
+
+    On TPU: `python bench.py --cp-flavor-sweep --model Llama-3.1-8B
+    --seqs 8192 16384 32768 65536`. CPU runs (`--cpu`, 8 simulated
+    devices, debug-tiny) are structural anchors only — they prove the
+    three flavors lower and step, not how fast.
+    """
+    from picotron_tpu.analysis.cost_model import CostModel
+    from picotron_tpu.config import (
+        Config, DistributedConfig, ModelConfig, TrainingConfig,
+        resolve_preset, resolved_cp_mesh,
+    )
+    from picotron_tpu.mesh import MeshEnv
+    from picotron_tpu.parallel.api import init_sharded_state, make_train_step
+
+    n_chips = len(jax.devices())
+    cp = cp or n_chips
+    if n_chips % cp or cp < 2:
+        raise SystemExit(f"--cp-flavor-sweep: {n_chips} device(s) not "
+                         f"divisible into cp={cp} sequence shards")
+    dp = n_chips // cp
+    preset = resolve_preset(model)
+    max_seq = max(seqs)
+    preset["max_position_embeddings"] = max(
+        preset.get("max_position_embeddings", max_seq), max_seq)
+    if layers:
+        preset["num_hidden_layers"] = layers
+    cost_model = CostModel(jax.devices()[0].device_kind)
+    rows = []
+    for seq in seqs:
+        for flavor in ("ring", "ulysses", "mesh"):
+            row = {"metric": f"cp_flavor_{model.split('/')[-1]}_cp{cp}",
+                   "cp_flavor": flavor, "cp": cp, "dp": dp, "seq": seq,
+                   "mbs": mbs,
+                   "device_kind": jax.devices()[0].device_kind,
+                   "is_tpu": jax.devices()[0].platform == "tpu"}
+            cfg = Config(
+                distributed=DistributedConfig(dp_size=dp, cp_size=cp,
+                                              cp_flavor=flavor),
+                model=ModelConfig(name=model, **preset),
+                training=TrainingConfig(seq_length=seq,
+                                        micro_batch_size=mbs),
+            )
+            try:
+                cfg.validate()
+            except ValueError as e:
+                row["infeasible"] = str(e)[:160]
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+                continue
+            if flavor == "mesh":
+                cp_x, cp_y = resolved_cp_mesh(cfg)
+                row["cp_mesh"] = f"{cp_x}x{cp_y}"
+            row["predicted_step_ms"] = round(
+                cost_model.predict(cfg).total_s * 1e3, 2)
+            menv = MeshEnv.from_config(cfg)
+            state = init_sharded_state(cfg, menv, jax.random.key(0))
+            step = make_train_step(cfg, menv)
+            toks = jax.random.randint(jax.random.key(1),
+                                      (1, mbs * dp, seq + 1),
+                                      0, cfg.model.vocab_size)
+            sharding = menv.batch_sharding()
+            batch = (jax.device_put(toks[..., :-1], sharding),
+                     jax.device_put(toks[..., 1:], sharding))
+            for _ in range(max(warmup, 1)):
+                state, metrics = step(state, batch)
+            float(metrics["loss"])  # drain the warmup chain
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, metrics = step(state, batch)
+            float(metrics["loss"])  # value fetch: every step must have run
+            step_ms = (time.perf_counter() - t0) / steps * 1e3
+            tokens_per_step = mbs * dp * seq
+            row.update({
+                "step_time_ms": round(step_ms, 2),
+                "tokens_per_sec": round(tokens_per_step / step_ms * 1e3, 1),
+                "loss": float(metrics["loss"]),
+            })
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    return rows
+
+
 def run_bwd_grid_sweep(model: str, seq: int, batch: int, steps: int = 5,
                        blocks=None) -> list:
     """Block-size sweep of the flash attention KERNEL PAIR (fwd, fwd+bwd)
@@ -1024,6 +1117,19 @@ def main() -> None:
     ap.add_argument("--n-micros", type=int, nargs="*",
                     default=[2, 4, 8, 16],
                     help="--pp-tick-sweep: microbatch counts to fit over")
+    ap.add_argument("--cp-flavor-sweep", action="store_true",
+                    help="time the train step under each cp flavor (ring/"
+                         "ulysses/mesh) at each --seqs length, with the "
+                         "cost model's prediction alongside (PERF.md "
+                         "Round 13 protocol); one JSON line per row. "
+                         "With --cpu, runs on 8 simulated devices as a "
+                         "structural anchor")
+    ap.add_argument("--seqs", type=int, nargs="*",
+                    default=[8192, 16384, 32768, 65536],
+                    help="sequence lengths for --cp-flavor-sweep")
+    ap.add_argument("--cp", type=int, default=0,
+                    help="cp degree for --cp-flavor-sweep (0 = all "
+                         "devices)")
     ap.add_argument("--bwd-grid-sweep", action="store_true",
                     help="sweep flash-attention (block_q, block_k) over "
                          "the fwd / fwd+bwd kernel pair at --seq (use "
@@ -1036,13 +1142,14 @@ def main() -> None:
                          "when no TPU backend is reachable")
     args = ap.parse_args()
 
-    if args.pp_tick_sweep and args.cpu:
+    if (args.pp_tick_sweep or args.cp_flavor_sweep) and args.cpu:
         # Provision the simulated stage x data devices BEFORE the first
         # backend-initializing jax call (require_backend's jax.devices()
         # pins the client) — same ordering contract as tools/memcheck.py.
         from picotron_tpu.mesh import force_host_device_count
 
-        force_host_device_count(max(args.pp, 8))
+        force_host_device_count(max(args.pp if args.pp_tick_sweep
+                                    else args.cp, 8))
 
     # Backend probe BEFORE any mode: a down TPU tunnel must be one line,
     # not the xla_bridge traceback BENCH_r05.json recorded. Children of
@@ -1051,10 +1158,21 @@ def main() -> None:
 
     if args.shardcheck and (args.sweep or args.decode or args.profile
                             or args.bwd_grid_sweep or args.serve
-                            or args.pp_tick_sweep):
+                            or args.pp_tick_sweep or args.cp_flavor_sweep):
         ap.error("--shardcheck is its own mode; incompatible with "
                  "--sweep/--decode/--profile/--bwd-grid-sweep/--serve/"
-                 "--pp-tick-sweep")
+                 "--pp-tick-sweep/--cp-flavor-sweep")
+
+    if args.cp_flavor_sweep:
+        if (args.sweep or args.decode or args.profile
+                or args.bwd_grid_sweep or args.serve or args.pp_tick_sweep):
+            ap.error("--cp-flavor-sweep is its own mode; incompatible "
+                     "with --sweep/--decode/--profile/--bwd-grid-sweep/"
+                     "--serve/--pp-tick-sweep")
+        run_cp_flavor_sweep(args.model, args.layers or 0,
+                            tuple(args.seqs), args.mbs or 1, cp=args.cp,
+                            steps=args.steps, warmup=args.warmup)
+        return
 
     if args.pp_tick_sweep:
         if (args.sweep or args.decode or args.profile

@@ -285,14 +285,44 @@ def audit_collectives(cfg, *, text: str = None, state=None,
                     f"all-gather, {len(sp_rs)} reduce-scatter ops of "
                     f"group size {d.tp_size})")
     if d.cp_size > 1:
-        if cfg.model.attn_impl == "ulysses":
+        from picotron_tpu.config import resolved_cp_flavor, resolved_cp_mesh
+
+        flavor = resolved_cp_flavor(cfg)
+        if flavor == "ulysses":
             cp_a2a = [op for op in eff if op.kind == "all_to_all"
                       and op.group_size == d.cp_size]
             if not cp_a2a:
                 rep.add(CHECK, ERROR, "all_to_all",
-                        f"attn_impl='ulysses' with cp_size={d.cp_size} "
+                        f"cp flavor 'ulysses' with cp_size={d.cp_size} "
                         f"but no all_to_all of group size {d.cp_size}: "
                         f"the Ulysses seq<->head trade is missing")
+        elif flavor == "mesh":
+            # the 2D schedule's signature: a head-scatter all_to_all whose
+            # group spans exactly the INNER factor and a row ring's
+            # collective_permute for the outer factor — each degenerate
+            # factorization drops exactly its own requirement
+            cp_x, cp_y = resolved_cp_mesh(cfg)
+            if cp_y > 1 and not any(
+                    op.kind == "all_to_all" and op.group_size == cp_y
+                    for op in eff):
+                rep.add(CHECK, ERROR, "all_to_all",
+                        f"mesh cp flavor {cp_x}x{cp_y} but no all_to_all "
+                        f"of group size {cp_y}: the head scatter over the "
+                        f"inner submesh factor is missing")
+            if cp_x > 1 and not any(op.kind == "collective_permute"
+                                    for op in eff):
+                rep.add(CHECK, ERROR, "collective_permute",
+                        f"mesh cp flavor {cp_x}x{cp_y} but the lowered "
+                        f"step contains no collective_permute: the row "
+                        f"ring over the outer submesh factor is missing")
+            if cp_x > 1 and cp_y > 1 and any(
+                    op.kind == "all_to_all" and op.group_size == d.cp_size
+                    for op in eff):
+                rep.add(CHECK, ERROR, "all_to_all",
+                        f"mesh cp flavor {cp_x}x{cp_y} but an all_to_all "
+                        f"spans the FULL cp axis (group size {d.cp_size}): "
+                        f"an implicit reshard widened the 2D schedule's "
+                        f"subgroup collective")
         elif not any(op.kind == "collective_permute" for op in eff):
             rep.add(CHECK, ERROR, "collective_permute",
                     f"cp_size={d.cp_size} (ring attention) but the "

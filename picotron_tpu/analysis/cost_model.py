@@ -44,7 +44,9 @@ import math
 from dataclasses import dataclass, replace
 from typing import Optional
 
-from picotron_tpu.config import Config, num_params
+from picotron_tpu.config import (
+    Config, num_params, resolved_cp_flavor, resolved_cp_mesh,
+)
 from picotron_tpu.utils import flops_per_token
 
 # ---------------------------------------------------------------------------
@@ -157,6 +159,30 @@ def place_axes(axis_sizes: dict, gen: IciGeneration) -> dict[str, AxisLink]:
         out[ax] = AxisLink(ax, n, kind,
                            gen.link_bandwidth / max(stride, 1), stride)
     return out
+
+
+def split_cp_link(link: AxisLink, cp_x: int, cp_y: int,
+                  gen: IciGeneration) -> tuple[AxisLink, AxisLink]:
+    """Factor one placed cp AxisLink into the mesh flavor's 2D submesh:
+    (outer cp_x row-ring link, inner cp_y head-scatter link).
+
+    The inner sub-axis is a contiguous slice of the physical placement, so
+    its logical-neighbor stride is the parent's and it closes into a ring
+    by the generation's own wrap rule (a cp_y-slice of a v5e 16-torus side
+    is a line; a full side is a ring). The outer sub-axis hops cp_y
+    physical neighbors per logical step — and all cp_y row rings shift
+    concurrently over the same links, so each pair sees 1/cp_y of the
+    parent bandwidth — but it inherits the parent's wraparound: if the
+    full cp axis closes, the stride-cp_y cycle closes with it. This is the
+    TASP-style observation that makes mesh win on wrap-less slices: the
+    ring leg shrinks from cp-1 line hops to cp_x-1, while the a2a leg
+    stays inside a short contiguous subgroup."""
+    inner_kind = "ring" if cp_y >= gen.wrap_min else "line"
+    inner = AxisLink("cp", cp_y, inner_kind, link.bandwidth, link.stride)
+    outer_kind = link.kind if cp_x > 1 else "line"
+    outer = AxisLink("cp", cp_x, outer_kind,
+                     link.bandwidth / max(cp_y, 1), link.stride * cp_y)
+    return outer, inner
 
 
 # ---------------------------------------------------------------------------
@@ -565,15 +591,38 @@ class CostModel:
                 add("tp_psum", "all_reduce", ("tp",), n_ops, v_act,
                     c.expose_layer)
 
-        # CP: ring (K/V shift chain fwd, K/V + dK/dV bwd) or the Ulysses
-        # seq<->head all_to_all pair each way
+        # CP: ring (K/V shift chain fwd, K/V + dK/dV bwd), the Ulysses
+        # seq<->head all_to_all pair each way, or the mesh flavor's 2D
+        # split — head scatter over the inner cp_y subgroup plus a K/V
+        # ring over the outer cp_x rows. The mesh row-block payload
+        # (cp_y-times-longer sequence on 1/cp_y of the KV heads) equals
+        # the 1D ring's per-hop v_kv exactly; what changes is the hop
+        # count (cp_x-1 vs cp-1) and the sub-link each leg runs on.
         if d.cp_size > 1:
-            if m.attn_impl == "ulysses":
+            flavor = resolved_cp_flavor(cfg)
+            kv_dim = m.num_key_value_heads * m.head_dim
+            v_kv = 2 * mbs * (s // d.cp_size) * kv_dim * act_bytes
+            if flavor == "ulysses":
                 add("ulysses_a2a", "all_to_all", ("cp",),
                     4 * layers_stage * ga, v_act, c.expose_layer)
+            elif flavor == "mesh" and "cp" in links:
+                cp_x, cp_y = resolved_cp_mesh(cfg)
+                outer, inner = split_cp_link(links["cp"], cp_x, cp_y,
+                                             self.gen)
+                if cp_y > 1:
+                    secs = self.collective_secs("all_to_all", v_act, inner)
+                    terms.append(CommTerm(
+                        "mesh_a2a", "all_to_all", ("cp",),
+                        4 * layers_stage * ga, v_act, secs,
+                        c.expose_layer))
+                if cp_x > 1:
+                    secs = self.collective_secs("collective_permute",
+                                                v_kv, outer)
+                    terms.append(CommTerm(
+                        "mesh_ring", "collective_permute", ("cp",),
+                        3 * (cp_x - 1) * layers_stage * ga, v_kv, secs,
+                        c.expose_layer))
             else:
-                kv_dim = m.num_key_value_heads * m.head_dim
-                v_kv = 2 * mbs * (s // d.cp_size) * kv_dim * act_bytes
                 add("cp_ring", "collective_permute", ("cp",),
                     3 * (d.cp_size - 1) * layers_stage * ga, v_kv,
                     c.expose_layer)
@@ -602,6 +651,9 @@ def layout_label(cfg: Config) -> str:
     bits = [f"dp{d.dp_size}", f"tp{d.tp_size}", f"pp{d.pp_size}",
             f"cp{d.cp_size}", f"ep{d.ep_size}"]
     flags = []
+    if d.cp_size > 1 and d.cp_flavor:
+        flags.append(d.cp_flavor + (f"-{d.cp_mesh}"
+                                    if d.cp_flavor == "mesh" else ""))
     if d.sequence_parallel:
         flags.append("sp")
     if d.zero1:
@@ -615,6 +667,93 @@ def layout_label(cfg: Config) -> str:
             tag += f"-v{pl.interleave}"
         flags.append(tag)
     return "x".join(bits) + (("+" + "+".join(flags)) if flags else "")
+
+
+# ---------------------------------------------------------------------------
+# CP-flavor crossover prediction
+# ---------------------------------------------------------------------------
+
+
+def _tp_local_heads(cfg: Config) -> tuple[int, int]:
+    m, tp = cfg.model, cfg.distributed.tp_size
+    return m.num_attention_heads // tp, m.num_key_value_heads // tp
+
+
+def feasible_cp_meshes(cfg: Config, cp: Optional[int] = None) -> list:
+    """True-2D (cp_x, cp_y) factorizations of the cp degree — both factors
+    > 1 (degenerates ARE ring/ulysses, not a distinct flavor) and cp_y
+    dividing the tp-local query AND kv head counts."""
+    cp = cp or cfg.distributed.cp_size
+    hq, hkv = _tp_local_heads(cfg)
+    return [(cp // y, y) for y in range(2, cp)
+            if cp % y == 0 and cp // y > 1
+            and hq % y == 0 and hkv % y == 0]
+
+
+def cp_flavor_costs(model: CostModel, cfg: Config) -> dict:
+    """Price each feasible cp flavor for cfg's cp degree: 'ring' always,
+    'ulysses' when the tp-local heads divide by cp, and 'mesh' as the best
+    true-2D factorization (None entries mark infeasible flavors). Mesh
+    values are (StepCost, (cp_x, cp_y))."""
+    d = cfg.distributed
+    out = {"ring": None, "ulysses": None, "mesh": None}
+    ring_cfg = replace(cfg, distributed=replace(
+        d, cp_flavor="ring", cp_mesh=""))
+    out["ring"] = model.predict(ring_cfg)
+    hq, hkv = _tp_local_heads(cfg)
+    if hq % d.cp_size == 0 and hkv % d.cp_size == 0:
+        out["ulysses"] = model.predict(replace(cfg, distributed=replace(
+            d, cp_flavor="ulysses", cp_mesh="")))
+    best = None
+    for cp_x, cp_y in feasible_cp_meshes(cfg):
+        cost = model.predict(replace(cfg, distributed=replace(
+            d, cp_flavor="mesh", cp_mesh=f"{cp_x}x{cp_y}")))
+        if best is None or cost.total_s < best[0].total_s:
+            best = (cost, (cp_x, cp_y))
+    out["mesh"] = best
+    return out
+
+
+def cp_crossover_table(model: CostModel, base: Config,
+                       cp_degrees=(2, 4, 8, 16, 32)) -> list[dict]:
+    """Sweep cp degree for `base`'s model/batch on `model`'s generation and
+    report, per degree, each flavor's predicted step time and the winner —
+    the table `tools/layout_planner.py --cp-crossover` prints. Degrees the
+    sequence length cannot shard (zigzag needs 2*cp | seq) are skipped."""
+    rows = []
+    for cp in cp_degrees:
+        if base.training.seq_length % (2 * cp) or cp < 2:
+            continue
+        cfg = replace(base, distributed=replace(
+            base.distributed, cp_size=cp, cp_flavor="", cp_mesh=""))
+        costs = cp_flavor_costs(model, cfg)
+        row = {"cp": cp, "generation": model.gen.name}
+        times = {}
+        for flavor in ("ring", "ulysses", "mesh"):
+            v = costs[flavor]
+            if flavor == "mesh" and v is not None:
+                cost, (cp_x, cp_y) = v
+                row["mesh_factorization"] = f"{cp_x}x{cp_y}"
+                v = cost
+            row[f"{flavor}_ms"] = (round(v.total_s * 1e3, 3)
+                                   if v is not None else None)
+            if v is not None:
+                times[flavor] = v.total_s
+        row["winner"] = min(times, key=times.get) if times else None
+        rows.append(row)
+    return rows
+
+
+def cp_crossover(model: CostModel, base: Config,
+                 cp_degrees=(2, 4, 8, 16, 32)) -> Optional[int]:
+    """Smallest swept cp degree where the mesh flavor's best factorization
+    beats ring AND ulysses — None if mesh never wins. On wrap-less slices
+    (v5e/v6e lines) the 1D ring pays cp-1 full-diameter wrap penalties and
+    mesh wins early; on wrapped v4/v5p rings the crossover moves out."""
+    for row in cp_crossover_table(model, base, cp_degrees):
+        if row["winner"] == "mesh":
+            return row["cp"]
+    return None
 
 
 # ---------------------------------------------------------------------------

@@ -82,6 +82,10 @@ class CollectiveSite:
     source: str      # 'picotron_tpu/<file>:<line>' that issued it
     scope: str       # enclosing function name (+ name_stack when present)
     roots: tuple     # root state/batch paths whose data feeds the op
+    group: int = 0   # axis_index_groups subgroup size (0 = the full axis
+    #                  — the mesh cp flavor's 2D schedule subgroups its
+    #                  collectives, so the lowered replica group is
+    #                  smaller than the named axis)
 
     def describe(self, max_roots: int = 3) -> str:
         roots = ", ".join(self.roots[:max_roots])
@@ -159,9 +163,11 @@ def _walk(jaxpr, env: dict, sites: list) -> list:
         name = eqn.primitive.name
         if name in _PRIM_KINDS:
             src, scope = _site_location(eqn)
+            groups = eqn.params.get("axis_index_groups")
             sites.append(CollectiveSite(
                 _PRIM_KINDS[name], name, _axis_names(eqn.params),
-                src, scope, tuple(sorted(in_prov))))
+                src, scope, tuple(sorted(in_prov)),
+                len(groups[0]) if groups else 0))
         subs = [s for v in eqn.params.values() for s in _sub_jaxprs(v)]
         for sub in subs:
             inner: dict = {}
@@ -240,7 +246,7 @@ def attribute_collectives(cfg, sites, ops) -> tuple:
     sizes = _axis_sizes(cfg)
     by_key: dict = {}
     for s in sites:
-        g = math.prod(sizes.get(a, 1) for a in s.axes)
+        g = s.group or math.prod(sizes.get(a, 1) for a in s.axes)
         by_key.setdefault((s.kind, g), []).append(s)
     permute_sites = [s for s in sites if s.kind == "collective_permute"
                      and math.prod(sizes.get(a, 1) for a in s.axes) > 1]
@@ -279,21 +285,29 @@ def intended_rule(cfg, site) -> str:
             # final norm / lm_head) assemble their disjoint partials over
             # the stage axis (parallel/pp.py sync_pp_replicated_grads)
             return "pp replicated-grad/loss-stat sync"
+    from picotron_tpu.config import resolved_cp_flavor
+
+    mesh_cp = d.cp_size > 1 and resolved_cp_flavor(cfg) == "mesh"
     if site.kind in ("all_gather", "reduce_scatter"):
         if ax == {"tp"} and d.sequence_parallel:
             return "Megatron-SP f/g pair"
         if ax == {"dp"} and d.zero1:
             return "ZeRO-1 shard round-trip"
+        if ax == {"cp"} and mesh_cp and site.group:
+            return "mesh row position gather"
     if site.kind == "collective_permute":
         if ax == {"cp"}:
-            return "ring-attention K/V shift"
+            return ("mesh row-ring K/V shift" if mesh_cp
+                    else "ring-attention K/V shift")
         if ax == {"pp"}:
             return "pipeline boundary exchange"
     if site.kind == "all_to_all":
         if ax == {"ep"}:
             return "expert dispatch/combine"
         if ax == {"cp"}:
-            return "Ulysses seq<->head trade"
+            return ("mesh head scatter (cp_y subgroup)"
+                    if mesh_cp and site.group
+                    else "Ulysses seq<->head trade")
     return None
 
 

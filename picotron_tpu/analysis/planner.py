@@ -78,7 +78,16 @@ class PlanPoint:
                  f"distributed.tp_size={d.tp_size}",
                  f"distributed.pp_size={d.pp_size}",
                  f"distributed.cp_size={d.cp_size}",
-                 f"distributed.ep_size={d.ep_size}",
+                 f"distributed.ep_size={d.ep_size}"]
+        if d.cp_flavor:
+            # the flavor axis the planner enumerated; attn_impl rides
+            # along so applying the line to a base whose attn_impl names
+            # a different cp schedule cannot contradict the flavor
+            parts.append(f"distributed.cp_flavor={d.cp_flavor}")
+            parts.append(f"model.attn_impl={self.cfg.model.attn_impl}")
+        if d.cp_mesh:
+            parts.append(f"distributed.cp_mesh={d.cp_mesh}")
+        parts += [
                  f"distributed.sequence_parallel="
                  f"{str(d.sequence_parallel).lower()}",
                  f"distributed.zero1={str(d.zero1).lower()}",
@@ -193,14 +202,38 @@ def _pipeline_options(base: Config, pp: int) -> list[PipelineConfig]:
     return opts
 
 
+_CP_FLAVOR_IMPLS = ("ring", "ulysses", "mesh")
+
+
+def _cp_flavor_options(base: Config, cp: int, tp: int) -> list[tuple]:
+    """(cp_flavor, cp_mesh) candidates for a cp-degree slice of the layout
+    space — the flavor is a free planner axis, like sp or zero1. Ring is
+    always schedulable; Ulysses needs the tp-local heads to divide by cp;
+    mesh enumerates every true-2D factorization whose inner factor divides
+    the tp-local query and kv heads (degenerate factorizations ARE the 1D
+    flavors, so they are not repeated here)."""
+    if cp <= 1:
+        return [("", "")]
+    opts = [("ring", "")]
+    hq = base.model.num_attention_heads // tp
+    hkv = base.model.num_key_value_heads // tp
+    if hq % cp == 0 and hkv % cp == 0:
+        opts.append(("ulysses", ""))
+    opts += [("mesh", f"{cp // y}x{y}") for y in range(2, cp)
+             if cp % y == 0 and cp // y > 1
+             and hq % y == 0 and hkv % y == 0]
+    return opts
+
+
 def candidate_configs(base: Config, chips: int,
                       *, flags: bool = True) -> list[Config]:
     """Every valid layout of `base` over `chips` devices. Flag knobs
     (sequence_parallel / zero1 / optimizer_offload) toggle only where they
     can matter (sp needs tp>1, zero1 needs dp>1); pipeline executor and
-    schedule enumerate only where pp > 1 (see _pipeline_options). Grad
-    accumulation is rederived so the global batch matches the base
-    config's."""
+    schedule enumerate only where pp > 1 (see _pipeline_options); the cp
+    flavor and its mesh factorization enumerate only where cp > 1 (see
+    _cp_flavor_options). Grad accumulation is rederived so the global
+    batch matches the base config's."""
     t = base.training
     global_batch = base.global_batch_size
     out = []
@@ -212,28 +245,45 @@ def candidate_configs(base: Config, chips: int,
         o_opts = (False, True) if flags else (False,)
         pipe_opts = _pipeline_options(base, pp) if flags \
             else [PipelineConfig()]
+        cp_opts = _cp_flavor_options(base, cp, tp) if flags \
+            else [(base.distributed.cp_flavor if cp > 1 else "",
+                   base.distributed.cp_mesh if cp > 1 else "")]
         for sp in sp_opts:
             for z1 in z_opts:
                 for off in o_opts:
                     for pl in pipe_opts:
-                        cfg = base.replace(
-                            distributed=dataclasses.replace(
-                                base.distributed, dp_size=dp, tp_size=tp,
-                                pp_size=pp, cp_size=cp, ep_size=ep,
-                                sequence_parallel=sp, zero1=z1),
-                            training=dataclasses.replace(
-                                t, gradient_accumulation_steps=ga,
-                                optimizer_offload=off,
-                                # offload demands bf16 + 1f1b; grad_engine
-                                # auto lets each layout pick its engine
-                                grad_engine="auto"),
-                            pipeline=pl,
-                        )
-                        try:
-                            cfg.validate()
-                        except (ValueError, KeyError):
-                            continue
-                        out.append(cfg)
+                        for flavor, cp_mesh in cp_opts:
+                            model_cfg = base.model
+                            if (model_cfg.attn_impl in _CP_FLAVOR_IMPLS
+                                    and flavor
+                                    and model_cfg.attn_impl != flavor):
+                                # a base pinned to one cp schedule by name
+                                # would contradict the enumerated flavor;
+                                # rename it (flash lowering is unchanged)
+                                model_cfg = dataclasses.replace(
+                                    model_cfg, attn_impl=flavor)
+                            cfg = base.replace(
+                                model=model_cfg,
+                                distributed=dataclasses.replace(
+                                    base.distributed, dp_size=dp,
+                                    tp_size=tp, pp_size=pp, cp_size=cp,
+                                    ep_size=ep, cp_flavor=flavor,
+                                    cp_mesh=cp_mesh,
+                                    sequence_parallel=sp, zero1=z1),
+                                training=dataclasses.replace(
+                                    t, gradient_accumulation_steps=ga,
+                                    optimizer_offload=off,
+                                    # offload demands bf16 + 1f1b;
+                                    # grad_engine auto lets each layout
+                                    # pick its engine
+                                    grad_engine="auto"),
+                                pipeline=pl,
+                            )
+                            try:
+                                cfg.validate()
+                            except (ValueError, KeyError):
+                                continue
+                            out.append(cfg)
     return out
 
 

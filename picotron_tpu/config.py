@@ -288,6 +288,19 @@ class DistributedConfig:
     # a zigzag TODO, ref: data.py:105-109, tests/test_dataloader.py:136).
     # "contiguous" reproduces the reference layout.
     cp_layout: str = "zigzag"
+    # Context-parallel attention schedule: "" derives the flavor from
+    # model.attn_impl (back-compat: "ring"/"ulysses"/"mesh" there select
+    # directly, anything else defaults to the ring). "mesh" is the 2D
+    # schedule (ops/mesh_attention.py): cp factors into cp_x x cp_y, an
+    # Ulysses-style head scatter runs within cp_y subgroups and a K/V
+    # ring over the cp_x rows — same per-hop volume as the ring but only
+    # cp_x-1 hops, head divisibility required only by cp_y.
+    cp_flavor: str = ""  # "" | "ring" | "ulysses" | "mesh"
+    # Mesh-flavor factorization "XxY" (e.g. "2x4": cp_x=2 ring rows,
+    # cp_y=4 head-scatter columns); X*Y must equal cp_size. "" picks the
+    # most-square feasible factorization (resolved_cp_mesh); the planner
+    # enumerates all feasible ones against the ICI cost model.
+    cp_mesh: str = ""
     # Expert parallelism: shards MoE expert banks over a dedicated mesh
     # axis; acts as an additional data axis for non-expert computation
     # (batch over the fused ('dp','ep') axes). Requires a MoE model
@@ -330,6 +343,35 @@ class DistributedConfig:
         if self.cp_layout not in ("zigzag", "contiguous"):
             raise ValueError(
                 f"cp_layout must be 'zigzag' or 'contiguous', got {self.cp_layout!r}")
+        if self.cp_flavor not in ("", "ring", "ulysses", "mesh"):
+            raise ValueError(
+                f"cp_flavor must be one of ring/ulysses/mesh (or empty to "
+                f"derive from model.attn_impl), got {self.cp_flavor!r}")
+        if self.cp_flavor and self.cp_size == 1:
+            raise ValueError(
+                f"cp_flavor={self.cp_flavor!r} requires cp_size > 1 (it "
+                "names a context-parallel schedule)")
+        if self.cp_mesh:
+            cp_x, cp_y = parse_cp_mesh(self.cp_mesh)
+            if cp_x * cp_y != self.cp_size:
+                raise ValueError(
+                    f"cp_mesh '{self.cp_mesh}' must factor the cp degree: "
+                    f"{cp_x} * {cp_y} != cp_size ({self.cp_size})")
+
+
+def parse_cp_mesh(spec: str) -> tuple[int, int]:
+    """'XxY' -> (cp_x, cp_y), with a field-naming error (not a bare int
+    crash) on malformed input."""
+    parts = spec.lower().split("x")
+    try:
+        cp_x, cp_y = (int(p) for p in parts)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"cp_mesh must be 'XxY' (two positive integers, e.g. '2x4'), "
+            f"got {spec!r}") from None
+    if cp_x < 1 or cp_y < 1:
+        raise ValueError(f"cp_mesh factors must be >= 1, got {spec!r}")
+    return cp_x, cp_y
 
 
 @dataclass(frozen=True)
@@ -420,10 +462,10 @@ class ModelConfig:
 
     def validate(self) -> None:
         if self.attn_impl not in ("auto", "flash", "reference", "ring",
-                                  "ulysses"):
+                                  "ulysses", "mesh"):
             raise ValueError(
-                f"attn_impl must be one of auto/flash/reference/ring/ulysses, got "
-                f"{self.attn_impl!r}"
+                f"attn_impl must be one of auto/flash/reference/ring/"
+                f"ulysses/mesh, got {self.attn_impl!r}"
             )
         if self.hidden_size % self.num_attention_heads != 0:
             raise ValueError("hidden_size must be divisible by num_attention_heads")
@@ -904,14 +946,37 @@ class Config:
             raise ValueError("num_key_value_heads must be divisible by tp_size")
         if m.vocab_size % d.tp_size != 0:
             raise ValueError("vocab_size must be divisible by tp_size")
-        if m.attn_impl == "ulysses" and d.cp_size > 1:
+        if (d.cp_flavor and m.attn_impl in ("ring", "ulysses", "mesh")
+                and m.attn_impl != d.cp_flavor):
+            raise ValueError(
+                f"distributed.cp_flavor={d.cp_flavor!r} contradicts "
+                f"model.attn_impl={m.attn_impl!r} — set one of them (or "
+                "attn_impl='auto' and let cp_flavor pick the schedule)")
+        flavor = resolved_cp_flavor(self)
+        if flavor == "ulysses":
             if (m.num_attention_heads // d.tp_size) % d.cp_size != 0 or (
                     m.num_key_value_heads // d.tp_size) % d.cp_size != 0:
                 raise ValueError(
-                    "attn_impl='ulysses' scatters the tp-local heads over "
+                    "the ulysses cp flavor scatters the tp-local heads over "
                     "cp: num_attention_heads/tp and num_key_value_heads/tp "
-                    f"must be divisible by cp_size ({d.cp_size}); use "
-                    "attn_impl='ring' for head counts that do not divide")
+                    f"must be divisible by cp_size ({d.cp_size}); use the "
+                    "ring or mesh flavor for head counts that do not divide")
+        if d.cp_mesh and flavor != "mesh":
+            raise ValueError(
+                f"cp_mesh={d.cp_mesh!r} only applies to the mesh cp flavor "
+                f"(resolved flavor here: {flavor or 'none — cp_size is 1'}); "
+                "set cp_flavor='mesh' or attn_impl='mesh'")
+        if flavor == "mesh":
+            cp_x, cp_y = resolved_cp_mesh(self)
+            if cp_y > 1 and (
+                    (m.num_attention_heads // d.tp_size) % cp_y != 0
+                    or (m.num_key_value_heads // d.tp_size) % cp_y != 0):
+                raise ValueError(
+                    f"mesh cp flavor with cp_mesh {cp_x}x{cp_y} scatters "
+                    f"the tp-local heads over the inner factor: "
+                    "num_attention_heads/tp and num_key_value_heads/tp "
+                    f"must be divisible by cp_y ({cp_y}); pick a smaller "
+                    "cp_y (cp_y=1 degenerates to the ring schedule)")
         if d.ep_size > 1 and m.num_experts == 0:
             raise ValueError(
                 "ep_size > 1 requires a mixture-of-experts model "
@@ -1122,6 +1187,43 @@ class Config:
 
     def replace(self, **sections: Any) -> "Config":
         return dataclasses.replace(self, **sections)
+
+
+def resolved_cp_flavor(cfg: "Config") -> str:
+    """The context-parallel attention schedule this config runs:
+    'ring' | 'ulysses' | 'mesh' when cp_size > 1, '' otherwise. The single
+    dispatch key for parallel/api.py, parallel/fused_bwd.py, the
+    collective-schedule audit and the cost model — distributed.cp_flavor
+    wins, model.attn_impl names a flavor directly for back-compat, and the
+    default is the ring (no head-divisibility constraint)."""
+    d, m = cfg.distributed, cfg.model
+    if d.cp_size <= 1:
+        return ""
+    if d.cp_flavor:
+        return d.cp_flavor
+    if m.attn_impl in ("ring", "ulysses", "mesh"):
+        return m.attn_impl
+    return "ring"
+
+
+def resolved_cp_mesh(cfg: "Config") -> tuple[int, int]:
+    """(cp_x, cp_y) for the mesh cp flavor. An explicit distributed.cp_mesh
+    wins; otherwise the most-square FEASIBLE factorization (cp_y must
+    divide the tp-local q and kv head counts), tie-broken toward the
+    larger cp_y — one all_to_all over more (contiguous, innermost-ICI)
+    devices is cheaper than an extra serial ring hop. The planner
+    enumerates every feasible factorization against the topology-aware
+    cost model instead of trusting this default."""
+    d, m = cfg.distributed, cfg.model
+    cp = d.cp_size
+    if d.cp_mesh:
+        return parse_cp_mesh(d.cp_mesh)
+    hq = m.num_attention_heads // d.tp_size
+    hkv = m.num_key_value_heads // d.tp_size
+    feasible = [y for y in range(1, cp + 1)
+                if cp % y == 0 and hq % y == 0 and hkv % y == 0]
+    cp_y = min(feasible, key=lambda y: (abs(y - cp ** 0.5), -y))
+    return cp // cp_y, cp_y
 
 
 # ---------------------------------------------------------------------------

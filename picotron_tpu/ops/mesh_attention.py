@@ -1,0 +1,302 @@
+"""Mesh attention: 2D-mesh context parallelism (cp = cp_x x cp_y).
+
+The third context-parallel schedule, after the K/V ring
+(ops/ring_attention.py) and Ulysses (ops/ulysses.py). Mesh-Attention
+(arxiv 2512.20968) factors the cp axis into a 2D submesh and runs a
+different collective along each factor; TASP (arxiv 2509.26541) shows the
+right factorization is a property of the physical topology — which is why
+the cost model (analysis/cost_model.py) prices factorizations from the
+per-generation ICI descriptors and the planner enumerates them.
+
+Schedule, per attention call:
+
+1. **Head scatter over the inner cp_y factor.** One Ulysses-style
+   all_to_all restricted to each row's cp_y-device subgroup
+   (`axis_index_groups` over the single named cp axis — the submesh never
+   becomes a real mesh axis, so nothing else in the stack changes):
+
+       q/k/v [B, S/cp, H, D]  ->  [B, S/cp_x, H/cp_y, D]
+
+   Each device now holds its ROW's combined sequence block on a head
+   subset.
+2. **K/V ring over the outer cp_x factor.** Row blocks rotate between
+   corresponding devices of adjacent rows (`ppermute` with row-wise pairs),
+   merging partials with the same online-softmax LSE update as the ring.
+3. The output rides the reverse all_to_all home.
+
+Why this beats both parents at large cp: the per-hop ring volume is
+IDENTICAL to ring attention's (the row block has cp_y x the sequence on
+1/cp_y the heads), but there are only cp_x-1 hops instead of cp-1 — the
+serial latency chain shrinks by the factor cp_y, paid for with one
+all_to_all pair whose subgroup spans only cp_y devices (contiguous on the
+cp axis, so it lands on the innermost — fastest — ICI links that
+`mesh_utils` assigns to later mesh axes). And the Ulysses head-divisibility
+constraint relaxes from cp to cp_y.
+
+Degenerate factorizations are exact: cp_y=1 IS the ring schedule (the
+all_to_all pair is elided, not lowered as a size-1 group), cp_x=1 IS
+Ulysses (no ring hops). Both are legal `cp_mesh` values; the planner
+prices all three flavors and picks.
+
+The fused grad engine enters through `mesh_attention_bwd_from_saved`: the
+forward (`return_lse=True`) saves the ROW-domain LSE (head-sharded,
+row-gathered — the analogue of Ulysses' inner-domain save), and the
+backward replays the identical all_to_all scatter around a second forward
+ring whose per-block grads — normalized by the saved LSE — are exactly
+additive, with dK/dV accumulators traveling the row ring alongside their
+blocks (the PR-3 contract shared by all three flavors).
+
+Positions are explicit and travel with their blocks, so any sequence
+layout (contiguous, zigzag) masks correctly; the row block's positions are
+one small subgroup all_gather of the per-device position vector.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+from jax import lax
+
+from picotron_tpu.ops.attention import sdpa_attention
+from picotron_tpu.ops.ring_attention import _merge
+
+
+def mesh_groups(cp_x: int, cp_y: int):
+    """(row_groups, ring_perm) over the single named cp axis for the
+    row-major cp_x x cp_y factorization: device cp-index i sits at
+    (row x, col y) = (i // cp_y, i % cp_y).
+
+    row_groups: the cp_y-device subgroups the head-scatter all_to_all and
+    the position all_gather run within — contiguous index ranges, so on
+    hardware they land on the innermost ICI links of the cp axis.
+    ring_perm: the (src, dst) pairs rotating row blocks to the next row's
+    corresponding device (the outer-factor ring).
+    """
+    row_groups = [[x * cp_y + y for y in range(cp_y)] for x in range(cp_x)]
+    ring_perm = [(x * cp_y + y, ((x + 1) % cp_x) * cp_y + y)
+                 for x in range(cp_x) for y in range(cp_y)]
+    return row_groups, ring_perm
+
+
+def _scatter_heads(x: jnp.ndarray, axis: str, groups) -> jnp.ndarray:
+    """[B, S_local, H, D] -> [B, S_local*cp_y, H/cp_y, D] within each row
+    subgroup (sequence shards concatenate in subgroup order)."""
+    return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True,
+                          axis_index_groups=groups)
+
+
+def _gather_heads(x: jnp.ndarray, axis: str, groups) -> jnp.ndarray:
+    """Inverse of _scatter_heads: [B, S_row, H/cp_y, D] -> [B, S_local, H, D]."""
+    return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True,
+                          axis_index_groups=groups)
+
+
+def _check_factorization(axis: str, cp_x: int, cp_y: int) -> None:
+    n = lax.psum(1, axis)  # static axis size
+    if cp_x * cp_y != n:
+        raise ValueError(
+            f"cp_mesh {cp_x}x{cp_y} does not factor the '{axis}' axis size "
+            f"{n} (config.validate should have caught this)")
+
+
+def _row_inputs(tensors, axis, groups, cp_y, q_positions):
+    """Scatter `tensors` into the row domain and gather the row's position
+    vector; the cp_y=1 degenerate elides the collectives entirely so the
+    lowering is bit-identical to the plain ring schedule."""
+    if cp_y == 1:
+        return tensors, q_positions
+    row = [_scatter_heads(t, axis, groups) for t in tensors]
+    row_pos = lax.all_gather(q_positions, axis, axis=0, tiled=True,
+                             axis_index_groups=groups)
+    return row, row_pos
+
+
+def mesh_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis: str = "cp",
+    cp_mesh: tuple[int, int],
+    q_positions: jnp.ndarray | None = None,
+    attn_block=None,
+    return_lse: bool = False,
+):
+    """Causal 2D-mesh attention over the named mesh axis `axis`.
+
+    Must be called inside shard_map with `axis` in scope and q/k already
+    RoPE-rotated (same contract as ring_attention — rotation commutes with
+    the head split, so pre-rotating keeps positions single-sourced in the
+    caller). Each device holds the sequence shard of its cp index:
+
+      q:    [B, S_local, Hq, D]
+      k, v: [B, S_local, Hkv, D]   (Hkv <= Hq, GQA unexpanded)
+
+    cp_mesh: the STATIC (cp_x, cp_y) factorization; cp_x * cp_y must equal
+        the axis size. Hq and Hkv must be divisible by cp_y
+        (config.validate enforces both from the config).
+    q_positions: optional [S_local] global positions of the local tokens;
+        defaults to the contiguous layout (same as ring_attention).
+    attn_block: blockwise attention with `sdpa_attention(...,
+        return_lse=True)`'s signature (the Pallas flash kernel slots in).
+    return_lse: also return the merged log-sum-exp [B, Hq/cp_y, S_row]
+        fp32 in the ROW domain (head-sharded, row-gathered) — the save
+        `mesh_attention_bwd_from_saved` consumes. The backward re-derives
+        the row-domain q/k/v/out by replaying the exact all_to_all, so the
+        lse never needs un/re-scattering round trips (the Ulysses
+        inner-domain convention).
+
+    Returns [B, S_local, Hq, D] in q.dtype (+ the row-domain lse when
+    `return_lse`).
+    """
+    cp_x, cp_y = cp_mesh
+    _check_factorization(axis, cp_x, cp_y)
+    s_local = q.shape[1]
+    if q_positions is None:
+        q_positions = lax.axis_index(axis) * s_local + jnp.arange(s_local)
+    if attn_block is None:
+        attn_block = partial(sdpa_attention, return_lse=True)
+    groups, ring_perm = mesh_groups(cp_x, cp_y)
+
+    (qh, kh, vh), row_pos = _row_inputs((q, k, v), axis, groups, cp_y,
+                                        q_positions)
+    b, s_row, h, d = qh.shape
+    out_acc = jnp.zeros((b, s_row, h, d), jnp.float32)
+    lse_acc = jnp.full((b, h, s_row), -jnp.inf, jnp.float32)
+    kv_positions = row_pos
+    q_max = jnp.max(row_pos)
+
+    for step in range(cp_x):
+        # Whole-block causal skip, same collective-free lax.cond contract
+        # as ring_attention (a fully-future row contributes exactly
+        # (out=0, lse=-inf), which is what the skip branch returns).
+        kv_pos = kv_positions
+
+        def compute(opnds, kv_pos=kv_pos):
+            q_, k_, v_ = opnds
+            ob, lb = attn_block(q_, k_, v_, causal=True,
+                                q_positions=row_pos, kv_positions=kv_pos)
+            return ob.astype(jnp.float32), lb.astype(jnp.float32)
+
+        def skip(opnds):
+            q_, k_, v_ = opnds
+            a = (q_.ravel()[0] + k_.ravel()[0]
+                 + v_.ravel()[0]).astype(jnp.float32) * 0.0
+            return (jnp.zeros((b, s_row, h, d), jnp.float32) + a,
+                    jnp.full((b, h, s_row), -jnp.inf, jnp.float32) + a)
+
+        fully_masked = jnp.min(kv_pos) > q_max
+        out_blk, lse_blk = lax.cond(fully_masked, skip, compute,
+                                    (qh, kh, vh))
+        out_acc, lse_acc = _merge(out_acc, lse_acc, out_blk, lse_blk)
+        if step != cp_x - 1:
+            # deliberate unroll: one row-block rotation per outer-ring hop
+            kh = lax.ppermute(kh, axis, ring_perm)  # shardcheck: ok
+            vh = lax.ppermute(vh, axis, ring_perm)  # shardcheck: ok
+            kv_positions = lax.ppermute(  # shardcheck: ok
+                kv_positions, axis, ring_perm)
+
+    out = out_acc.astype(q.dtype)
+    if cp_y > 1:
+        out = _gather_heads(out, axis, groups)
+    return (out, lse_acc) if return_lse else out
+
+
+def mesh_attention_bwd_from_saved(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    out: jnp.ndarray,
+    lse: jnp.ndarray,
+    dout: jnp.ndarray,
+    *,
+    axis: str = "cp",
+    cp_mesh: tuple[int, int],
+    q_positions: jnp.ndarray | None = None,
+    sm_scale: float | None = None,
+    block_bwd=None,
+):
+    """(dq, dk, dv) for 2D-mesh attention from the forward's saved
+    (out, lse) — the manual-VJP entry for the fused grad engine
+    (parallel/fused_bwd.py), completing the PR-3 contract for the third
+    flavor.
+
+    q/k/v/out/dout arrive in the OUTER domain [B, S_local, H, D] (out is
+    the forward's gathered-home return); lse is the forward's saved
+    ROW-domain statistic [B, Hq/cp_y, S_row] fp32. The backward replays
+    the forward's head scatter on all five operands, then runs a second
+    forward ring over cp_x: each visiting row block's grads — computed by
+    `block_bwd` against the globally-merged saved lse — are its exact
+    additive contribution (the sdpa_attention_bwd_from_saved block
+    property), dQ accumulates locally, dK/dV accumulators travel the row
+    ring WITH their blocks, a final hop delivers them home (cp_x hops =
+    the row ring's identity), and the reverse all_to_all returns all
+    three grads to the outer domain.
+    """
+    from picotron_tpu.ops.flash_attention import flash_attention_bwd_from_saved
+
+    cp_x, cp_y = cp_mesh
+    _check_factorization(axis, cp_x, cp_y)
+    s_local = q.shape[1]
+    if q_positions is None:
+        q_positions = lax.axis_index(axis) * s_local + jnp.arange(s_local)
+    if block_bwd is None:
+        block_bwd = flash_attention_bwd_from_saved
+    groups, ring_perm = mesh_groups(cp_x, cp_y)
+
+    (qh, kh, vh, oh, doh), row_pos = _row_inputs(
+        (q, k, v, out, dout), axis, groups, cp_y, q_positions)
+    dq_acc = jnp.zeros(qh.shape, jnp.float32)
+    dk_acc = jnp.zeros(kh.shape, jnp.float32)
+    dv_acc = jnp.zeros(vh.shape, jnp.float32)
+    kv_positions = row_pos
+    q_max = jnp.max(row_pos)
+
+    for step in range(cp_x):
+        kv_pos = kv_positions
+
+        def compute(opnds, kv_pos=kv_pos):
+            q_, k_, v_ = opnds
+            dq_b, dk_b, dv_b = block_bwd(
+                q_, k_, v_, oh, lse, doh, causal=True,
+                q_positions=row_pos, kv_positions=kv_pos,
+                sm_scale=sm_scale)
+            return (dq_b.astype(jnp.float32), dk_b.astype(jnp.float32),
+                    dv_b.astype(jnp.float32))
+
+        def skip(opnds):
+            q_, k_, v_ = opnds
+            a = (q_.ravel()[0] + k_.ravel()[0]
+                 + v_.ravel()[0]).astype(jnp.float32) * 0.0
+            return (jnp.zeros(q_.shape, jnp.float32) + a,
+                    jnp.zeros(k_.shape, jnp.float32) + a,
+                    jnp.zeros(v_.shape, jnp.float32) + a)
+
+        fully_masked = jnp.min(kv_pos) > q_max
+        dq_b, dk_b, dv_b = lax.cond(fully_masked, skip, compute,
+                                    (qh, kh, vh))
+        dq_acc = dq_acc + dq_b
+        dk_acc = dk_acc + dk_b
+        dv_acc = dv_acc + dv_b
+        if step != cp_x - 1:
+            # deliberate unroll: one row-block + dK/dV rotation per hop
+            kh = lax.ppermute(kh, axis, ring_perm)  # shardcheck: ok
+            vh = lax.ppermute(vh, axis, ring_perm)  # shardcheck: ok
+            kv_positions = lax.ppermute(  # shardcheck: ok
+                kv_positions, axis, ring_perm)
+            dk_acc = lax.ppermute(dk_acc, axis, ring_perm)  # shardcheck: ok
+            dv_acc = lax.ppermute(dv_acc, axis, ring_perm)  # shardcheck: ok
+    if cp_x > 1:
+        # one more hop delivers every row block's dK/dV back to its owner
+        dk_acc = lax.ppermute(dk_acc, axis, ring_perm)
+        dv_acc = lax.ppermute(dv_acc, axis, ring_perm)
+
+    dq = dq_acc.astype(q.dtype)
+    dk = dk_acc.astype(k.dtype)
+    dv = dv_acc.astype(v.dtype)
+    if cp_y > 1:
+        dq = _gather_heads(dq, axis, groups)
+        dk = _gather_heads(dk, axis, groups)
+        dv = _gather_heads(dv, axis, groups)
+    return dq, dk, dv

@@ -35,7 +35,9 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from picotron_tpu import compat
-from picotron_tpu.config import Config
+from picotron_tpu.config import (
+    Config, resolved_cp_flavor, resolved_cp_mesh,
+)
 from picotron_tpu.mesh import MeshEnv
 from picotron_tpu.models.llama import (
     ParallelCtx, init_params, loss_sum_count, pad_layers_for_pp,
@@ -86,18 +88,20 @@ def make_parallel_ctx(cfg: Config) -> ParallelCtx:
     # FLASH_ATTEN / CONTEXT_PARALLEL env vars, ref: model.py:148-158):
     # flash = the Pallas kernel on TPU (jnp twin elsewhere), reference = the
     # plain jnp softmax path, ring = require context parallelism.
-    if cfg.model.attn_impl in ("ring", "ulysses") and d.cp_size == 1:
+    if cfg.model.attn_impl in ("ring", "ulysses", "mesh") and d.cp_size == 1:
         raise ValueError(
             f"attn_impl={cfg.model.attn_impl!r} requires cp_size > 1 (it is "
             "a context-parallel schedule; ref: context_parallel.py:10-12)"
         )
-    use_flash = cfg.model.attn_impl in ("auto", "flash", "ring", "ulysses")
+    use_flash = cfg.model.attn_impl in ("auto", "flash", "ring", "ulysses",
+                                        "mesh")
     if use_flash:
         from picotron_tpu.ops.flash_attention import flash_attention as attn_fn
     else:
         from picotron_tpu.ops.attention import sdpa_attention as attn_fn
 
-    if d.cp_size > 1 and cfg.model.attn_impl == "ulysses":
+    cp_flavor = resolved_cp_flavor(cfg)
+    if d.cp_size > 1 and cp_flavor == "ulysses":
         from picotron_tpu.ops.ulysses import (
             ulysses_attention, ulysses_static_layout,
         )
@@ -122,6 +126,22 @@ def make_parallel_ctx(cfg: Config) -> ParallelCtx:
                                      # full_pos is built from the config
                                      # right here — a trace-time constant
                                      positions_static=True)
+    elif d.cp_size > 1 and cp_flavor == "mesh":
+        from picotron_tpu.ops.mesh_attention import mesh_attention
+        from picotron_tpu.ops.rope import apply_rope
+
+        cp_mesh = resolved_cp_mesh(cfg)
+        blockwise = partial(attn_fn, return_lse=True)
+
+        def attn(q, k, v, pos, rope):
+            # same pre-rotation contract as the ring (rotation commutes
+            # with the head split, so positions stay single-sourced here);
+            # the 2D schedule factors cp into a cp_y head scatter and a
+            # cp_x row ring (ops/mesh_attention.py)
+            q = apply_rope(q, *rope, pos)
+            k = apply_rope(k, *rope, pos)
+            return mesh_attention(q, k, v, axis="cp", cp_mesh=cp_mesh,
+                                  q_positions=pos, attn_block=blockwise)
     elif d.cp_size > 1:
         from picotron_tpu.ops.ring_attention import ring_attention
         from picotron_tpu.ops.rope import apply_rope

@@ -83,9 +83,9 @@ from picotron_tpu.ops.rmsnorm import rms_norm
 
 def fused_bwd_supported(cfg: Config) -> bool:
     """True when the fused grad engine covers this config: any
-    single-pipeline-stage layout (dp/tp/SP/cp ring|ulysses/ep/MoE) under
-    remat with the dots_attn policy — the save set this engine's manual
-    backward is derived from. pp > 1 keeps the AD/1F1B engines (the
+    single-pipeline-stage layout (dp/tp/SP/cp ring|ulysses|mesh/ep/MoE)
+    under remat with the dots_attn policy — the save set this engine's
+    manual backward is derived from. pp > 1 keeps the AD/1F1B engines (the
     pipeline scan subsumes the microbatch loop), and other remat policies
     keep the AD engine (their save sets differ from the manual backward's
     recompute plan)."""
@@ -111,11 +111,14 @@ def _attn_paths(cfg: Config, ctx: ParallelCtx, cos, sin):
     The lse is whatever statistic the schedule's `*_bwd_from_saved` twin
     consumes: the kernel LSE (cp=1), the globally merged ring LSE, or the
     inner-domain Ulysses LSE."""
+    from picotron_tpu.config import resolved_cp_flavor, resolved_cp_mesh
+
     d, m = cfg.distributed, cfg.model
     pos = ctx.positions
-    use_flash = m.attn_impl in ("auto", "flash", "ring", "ulysses")
+    use_flash = m.attn_impl in ("auto", "flash", "ring", "ulysses", "mesh")
+    cp_flavor = resolved_cp_flavor(cfg)
 
-    if d.cp_size > 1 and m.attn_impl == "ulysses":
+    if d.cp_size > 1 and cp_flavor == "ulysses":
         from picotron_tpu.ops.ulysses import (
             ulysses_attention, ulysses_attention_bwd_from_saved,
             ulysses_static_layout,
@@ -133,6 +136,44 @@ def _attn_paths(cfg: Config, ctx: ParallelCtx, cos, sin):
         def attn_bwd(q, k, v, out, lse, dout):
             return ulysses_attention_bwd_from_saved(q, k, v, out, lse,
                                                     dout, **uly_kw)
+
+        return attn_fwd, attn_bwd
+
+    if d.cp_size > 1 and cp_flavor == "mesh":
+        from picotron_tpu.ops.attention import (
+            sdpa_attention, sdpa_attention_bwd_from_saved,
+        )
+        from picotron_tpu.ops.mesh_attention import (
+            mesh_attention, mesh_attention_bwd_from_saved,
+        )
+        from picotron_tpu.ops.rope import apply_rope
+
+        cp_mesh = resolved_cp_mesh(cfg)
+        blockwise = partial(
+            (flash_attention if use_flash else sdpa_attention),
+            return_lse=True)
+        block_bwd = (flash_attention_bwd_from_saved if use_flash
+                     else sdpa_attention_bwd_from_saved)
+
+        def rot_pair(q, k):
+            # pre-rotation, same single-sourcing as the ring branch below
+            return jax.vjp(
+                lambda q_, k_: (apply_rope(q_, cos, sin, pos),
+                                apply_rope(k_, cos, sin, pos)), q, k)
+
+        def attn_fwd(q, k, v):
+            (qr, kr), _ = rot_pair(q, k)
+            return mesh_attention(qr, kr, v, axis="cp", cp_mesh=cp_mesh,
+                                  q_positions=pos, attn_block=blockwise,
+                                  return_lse=True)
+
+        def attn_bwd(q, k, v, out, lse, dout):
+            (qr, kr), rot_vjp = rot_pair(q, k)
+            dqr, dkr, dv = mesh_attention_bwd_from_saved(
+                qr, kr, v, out, lse, dout, axis="cp", cp_mesh=cp_mesh,
+                q_positions=pos, block_bwd=block_bwd)
+            dq, dk = rot_vjp((dqr, dkr))
+            return dq, dk, dv
 
         return attn_fwd, attn_bwd
 

@@ -1,0 +1,412 @@
+"""Mesh-attention tests: the 2D-mesh cp schedule (ops/mesh_attention.py)
+pinned against dense AD, its config surface validated loudly, the
+collective-schedule audit's mesh rules mutation-tested, and the cost
+model's submesh pricing + crossover prediction checked for the properties
+PERF.md Round 13 claims (ring/ulysses degenerates exact, mesh wins where
+ulysses is head-infeasible, topology moves the crossover)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from picotron_tpu import compat
+from picotron_tpu.analysis.collectives import audit_collectives
+from picotron_tpu.analysis.cost_model import (
+    CostModel, GENERATIONS, cp_crossover, cp_crossover_table,
+    cp_flavor_costs, feasible_cp_meshes, place_axes, split_cp_link,
+)
+from picotron_tpu.analysis import lower_train_step
+from picotron_tpu.analysis.planner import candidate_configs, plan
+from picotron_tpu.config import (
+    Config, DistributedConfig, ModelConfig, TrainingConfig, parse_cp_mesh,
+    resolve_preset, resolved_cp_flavor, resolved_cp_mesh,
+)
+from picotron_tpu.mesh import MeshEnv
+from picotron_tpu.ops.attention import sdpa_attention
+from picotron_tpu.ops.mesh_attention import (
+    mesh_attention, mesh_attention_bwd_from_saved, mesh_groups,
+)
+from picotron_tpu.ops.ring_attention import ring_attention
+
+TOL = dict(rtol=2e-5, atol=2e-5)
+
+
+def qkvd(key=0, b=2, s=32, hq=4, hkv=2, d=8, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.key(key), 4)
+    return (jax.random.normal(ks[0], (b, s, hq, d), dtype),
+            jax.random.normal(ks[1], (b, s, hkv, d), dtype),
+            jax.random.normal(ks[2], (b, s, hkv, d), dtype),
+            jax.random.normal(ks[3], (b, s, hq, d), dtype))
+
+
+def dense_ref(q, k, v, do):
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: sdpa_attention(q_, k_, v_, causal=True),
+        q, k, v)
+    return vjp(do)
+
+
+def assert_grads(got, want, tag=""):
+    for g, w, n in zip(got, want, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   err_msg=f"{tag}{n}", **TOL)
+
+
+def zigzag_perm(s, cp):
+    half = s // (2 * cp)
+    return np.concatenate([
+        np.concatenate([np.arange(r * half, (r + 1) * half),
+                        np.arange((2 * cp - 1 - r) * half,
+                                  (2 * cp - r) * half)])
+        for r in range(cp)])
+
+
+# ---------------------------------------------------------------------------
+# schedule structure
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_groups_row_major():
+    groups, perm = mesh_groups(2, 4)
+    # rows are contiguous cp-index ranges (they land on innermost links)
+    assert groups == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    # the ring rotates each column to the next row's same column
+    assert sorted(perm) == sorted(
+        [(i, (i + 4) % 8) for i in range(8)])
+    # every device sends and receives exactly once per hop
+    assert len({s for s, _ in perm}) == 8
+    assert len({d for _, d in perm}) == 8
+
+
+# ---------------------------------------------------------------------------
+# forward/backward parity vs dense AD
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cp_x,cp_y,hq,hkv",
+                         [(2, 2, 4, 2), (4, 2, 4, 2), (2, 4, 4, 4)])
+def test_mesh_forward_matches_dense(cp_x, cp_y, hq, hkv):
+    cp = cp_x * cp_y
+    menv = MeshEnv.create(cp=cp)
+    q, k, v, _ = qkvd(hq=hq, hkv=hkv)
+    want = sdpa_attention(q, k, v, causal=True)
+
+    def body(q, k, v):
+        return mesh_attention(q, k, v, cp_mesh=(cp_x, cp_y))
+
+    got = jax.jit(compat.shard_map(
+        body, mesh=menv.mesh, in_specs=(P(None, "cp"),) * 3,
+        out_specs=P(None, "cp")))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), **TOL)
+
+
+@pytest.mark.parametrize("cp_x,cp_y,hq,hkv",
+                         [(2, 2, 4, 2), (4, 2, 4, 2), (2, 4, 4, 4)])
+def test_mesh_bwd_from_saved_matches_dense_grads(cp_x, cp_y, hq, hkv):
+    cp = cp_x * cp_y
+    menv = MeshEnv.create(cp=cp)
+    q, k, v, do = qkvd(hq=hq, hkv=hkv)
+
+    def body(q, k, v, do):
+        out, lse = mesh_attention(q, k, v, cp_mesh=(cp_x, cp_y),
+                                  return_lse=True)
+        return mesh_attention_bwd_from_saved(q, k, v, out, lse, do,
+                                             cp_mesh=(cp_x, cp_y))
+
+    got = jax.jit(compat.shard_map(
+        body, mesh=menv.mesh, in_specs=(P(None, "cp"),) * 4,
+        out_specs=(P(None, "cp"),) * 3))(q, k, v, do)
+    assert_grads(got, dense_ref(q, k, v, do), f"mesh{cp_x}x{cp_y}-")
+
+
+def test_mesh_degenerate_cp_y1_is_ring():
+    """cp_mesh=(cp,1) elides the all_to_all pair entirely — the schedule
+    IS the 1D K/V ring, so outputs agree with ring_attention to fp noise
+    and with dense to the shared tolerance."""
+    cp = 4
+    menv = MeshEnv.create(cp=cp)
+    q, k, v, _ = qkvd()
+
+    def mesh_body(q, k, v):
+        return mesh_attention(q, k, v, cp_mesh=(cp, 1))
+
+    def ring_body(q, k, v):
+        return ring_attention(q, k, v)
+
+    sm = dict(mesh=menv.mesh, in_specs=(P(None, "cp"),) * 3,
+              out_specs=P(None, "cp"))
+    got = jax.jit(compat.shard_map(mesh_body, **sm))(q, k, v)
+    ring = jax.jit(compat.shard_map(ring_body, **sm))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ring),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_mesh_degenerate_cp_x1_is_ulysses():
+    """cp_mesh=(1,cp): no ring hops, one full-axis head scatter — the
+    Ulysses schedule. Needs cp | heads, like Ulysses."""
+    cp = 4
+    menv = MeshEnv.create(cp=cp)
+    q, k, v, _ = qkvd(hq=4, hkv=4)
+    want = sdpa_attention(q, k, v, causal=True)
+
+    def body(q, k, v):
+        return mesh_attention(q, k, v, cp_mesh=(1, cp))
+
+    got = jax.jit(compat.shard_map(
+        body, mesh=menv.mesh, in_specs=(P(None, "cp"),) * 3,
+        out_specs=P(None, "cp")))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), **TOL)
+
+
+def test_mesh_bwd_from_saved_zigzag_layout():
+    """Positions travel with their blocks: the zigzag sequence layout must
+    mask correctly through both the head scatter and the row ring."""
+    cp, s = 4, 32
+    menv = MeshEnv.create(cp=cp)
+    q, k, v, do = qkvd(s=s)
+    perm = zigzag_perm(s, cp)
+
+    def body(q, k, v, do, pos):
+        out, lse = mesh_attention(q, k, v, cp_mesh=(2, 2),
+                                  q_positions=pos, return_lse=True)
+        return mesh_attention_bwd_from_saved(q, k, v, out, lse, do,
+                                             cp_mesh=(2, 2),
+                                             q_positions=pos)
+
+    got = jax.jit(compat.shard_map(
+        body, mesh=menv.mesh,
+        in_specs=(P(None, "cp"),) * 4 + (P("cp"),),
+        out_specs=(P(None, "cp"),) * 3))(
+        q[:, perm], k[:, perm], v[:, perm], do[:, perm],
+        jnp.asarray(perm))
+    inv = np.argsort(perm)
+    got = tuple(np.asarray(g)[:, inv] for g in got)
+    assert_grads(got, dense_ref(q, k, v, do), "mesh-zz-")
+
+
+# ---------------------------------------------------------------------------
+# config surface
+# ---------------------------------------------------------------------------
+
+
+def mkcfg(model="debug-tiny", seq=64, dist=None, train=None, **model_over):
+    over = resolve_preset(model)
+    over.update(model_over)
+    cfg = Config(
+        distributed=DistributedConfig(**(dist or {})),
+        model=ModelConfig(name=model, **over),
+        training=TrainingConfig(seq_length=seq, micro_batch_size=1,
+                                gradient_accumulation_steps=2,
+                                **(train or {})),
+    )
+    cfg.validate()
+    return cfg
+
+
+def test_parse_cp_mesh():
+    assert parse_cp_mesh("2x4") == (2, 4)
+    with pytest.raises(ValueError, match="XxY"):
+        parse_cp_mesh("2by4")
+    with pytest.raises(ValueError, match=">= 1"):
+        parse_cp_mesh("0x2")
+
+
+def test_cp_mesh_must_factor_cp_degree():
+    with pytest.raises(ValueError, match="must factor the cp degree"):
+        mkcfg(dist=dict(dp_size=2, cp_size=4, cp_flavor="mesh",
+                        cp_mesh="3x2"))
+
+
+def test_cp_flavor_contradicting_attn_impl_rejected():
+    with pytest.raises(ValueError, match="contradicts"):
+        mkcfg(dist=dict(dp_size=2, cp_size=4, cp_flavor="mesh"),
+              attn_impl="ring")
+
+
+def test_cp_mesh_requires_mesh_flavor():
+    with pytest.raises(ValueError, match="only applies to the mesh"):
+        mkcfg(dist=dict(dp_size=2, cp_size=4, cp_flavor="ring",
+                        cp_mesh="2x2"))
+
+
+def test_mesh_head_divisibility_enforced():
+    # debug-tiny has hkv=2: cp_y=4 cannot scatter the kv heads
+    with pytest.raises(ValueError, match="scatters"):
+        mkcfg(dist=dict(cp_size=8, cp_flavor="mesh", cp_mesh="2x4"))
+
+
+def test_resolved_flavor_and_default_factorization():
+    cfg = mkcfg(dist=dict(dp_size=2, cp_size=4), attn_impl="mesh")
+    assert resolved_cp_flavor(cfg) == "mesh"
+    # most-square feasible: debug-tiny (hq=4, hkv=2) at cp=4 -> 2x2
+    assert resolved_cp_mesh(cfg) == (2, 2)
+
+
+# ---------------------------------------------------------------------------
+# collective-schedule audit (mutation-tested, like the ulysses rule)
+# ---------------------------------------------------------------------------
+
+
+def _mesh_cfg():
+    return mkcfg(dist=dict(dp_size=2, cp_size=4, cp_flavor="mesh",
+                           cp_mesh="2x2"),
+                 train=dict(grad_engine="fused",
+                            remat_policy="dots_attn"),
+                 attn_impl="mesh", num_attention_heads=8,
+                 num_key_value_heads=4)
+
+
+def test_mesh_audit_requires_subgroup_a2a_and_row_ring():
+    cfg = _mesh_cfg()
+    low = lower_train_step(cfg)
+    rep = audit_collectives(cfg, text=low.text, state=low.state)
+    assert rep.ok(), rep.render()
+    # delete the head-scatter all_to_alls: the inner-factor rule must fire
+    bad = audit_collectives(
+        cfg, text=low.text.replace("stablehlo.all_to_all",
+                                   "stablehlo.xx_gone"),
+        state=low.state)
+    assert any("mesh cp flavor" in f.message and "all_to_all" in f.message
+               for f in bad.errors()), bad.render()
+    # delete the row ring's collective_permutes: the outer rule must fire
+    bad = audit_collectives(
+        cfg, text=low.text.replace("stablehlo.collective_permute",
+                                   "stablehlo.xx_gone"),
+        state=low.state)
+    assert any("mesh cp flavor" in f.message for f in bad.errors()), \
+        bad.render()
+
+
+# ---------------------------------------------------------------------------
+# cost model: submesh pricing + crossover
+# ---------------------------------------------------------------------------
+
+
+def test_split_cp_link_physics():
+    gen = GENERATIONS["v5e"]
+    link = place_axes({"cp": 8}, gen)["cp"]
+    outer, inner = split_cp_link(link, 4, 2, gen)
+    # inner subgroup: contiguous slice of the parent axis
+    assert inner.size == 2
+    assert inner.bandwidth == link.bandwidth
+    assert inner.stride == link.stride
+    assert inner.kind == "line"  # 2 < v5e wrap_min (16)
+    # outer ring: strided by cp_y, bandwidth shared by cp_y row rings
+    assert outer.size == 4
+    assert outer.bandwidth == link.bandwidth / 2
+    assert outer.stride == link.stride * 2
+    assert outer.kind == link.kind
+    # a wrap-capable generation closes the inner ring once cp_y >= wrap_min
+    gen4 = GENERATIONS["v4"]
+    link4 = place_axes({"cp": 16}, gen4)["cp"]
+    _, inner4 = split_cp_link(link4, 4, 4, gen4)
+    assert inner4.kind == "ring"
+
+
+def test_mesh_terms_replace_ring_terms():
+    cfg = _mesh_cfg()
+    cost = CostModel("v5e").predict(cfg)
+    names = {t.name for t in cost.comm}
+    assert "mesh_a2a" in names and "mesh_ring" in names
+    assert "cp_ring" not in names and "ulysses_a2a" not in names
+    ring_cfg = dataclasses.replace(cfg, distributed=dataclasses.replace(
+        cfg.distributed, cp_flavor="ring", cp_mesh=""),
+        model=dataclasses.replace(cfg.model, attn_impl="ring"))
+    ring_names = {t.name for t in CostModel("v5e").predict(ring_cfg).comm}
+    assert "cp_ring" in ring_names and "mesh_ring" not in ring_names
+
+
+def test_feasible_cp_meshes_respects_heads():
+    # debug-tiny (hq=4, hkv=2): only cp_y=2 survives at cp=8
+    cfg = mkcfg(dist=dict(cp_size=8))
+    assert feasible_cp_meshes(cfg) == [(4, 2)]
+    # MHA heads open every factorization
+    cfg = mkcfg(dist=dict(cp_size=8), num_attention_heads=8,
+                num_key_value_heads=8)
+    assert feasible_cp_meshes(cfg) == [(4, 2), (2, 4)]
+
+
+def _crossover_base(preset, seq=16384):
+    cfg = Config(
+        distributed=DistributedConfig(),
+        model=ModelConfig(name=preset, **resolve_preset(preset)),
+        training=TrainingConfig(seq_length=seq, micro_batch_size=1,
+                                gradient_accumulation_steps=1),
+    )
+    cfg.validate()
+    return cfg
+
+
+def test_gqa_crossover_where_ulysses_dies():
+    """Llama-3.1-8B (hkv=8): ulysses is head-infeasible past cp=8, so the
+    mesh flavor takes over at cp=16 — on every ICI generation."""
+    base = _crossover_base("meta-llama/Llama-3.1-8B")
+    for gen in GENERATIONS:
+        model = CostModel(gen)
+        assert cp_crossover(model, base) == 16, gen
+        rows = {r["cp"]: r for r in cp_crossover_table(model, base)}
+        assert rows[16]["ulysses_ms"] is None
+        assert rows[16]["winner"] == "mesh"
+        assert rows[16]["mesh_ms"] < rows[16]["ring_ms"]
+
+
+def test_mha_model_never_crosses_to_mesh():
+    """Llama-2-7B (MHA, hkv=32): ulysses stays feasible at every swept
+    degree and mesh (= ulysses a2a over a subgroup + an extra ring leg)
+    never wins."""
+    base = _crossover_base("meta-llama/Llama-2-7b-hf", seq=4096)
+    assert cp_crossover(CostModel("v5e"), base) is None
+    costs = cp_flavor_costs(CostModel("v5e"), dataclasses.replace(
+        base, distributed=dataclasses.replace(base.distributed,
+                                              cp_size=8)))
+    assert costs["ulysses"] is not None
+    assert costs["ulysses"].total_s <= costs["mesh"][0].total_s
+
+
+# ---------------------------------------------------------------------------
+# planner enumeration
+# ---------------------------------------------------------------------------
+
+
+def test_planner_enumerates_cp_flavors_and_overrides_round_trip():
+    base = mkcfg()
+    base = dataclasses.replace(base, training=dataclasses.replace(
+        base.training, gradient_accumulation_steps=8))
+    cands = candidate_configs(base, 8)
+    by_flavor = {}
+    for c in cands:
+        if c.distributed.cp_size == 4:
+            by_flavor.setdefault(c.distributed.cp_flavor, c)
+    assert "ring" in by_flavor and "mesh" in by_flavor
+    assert by_flavor["mesh"].distributed.cp_mesh == "2x2"
+
+    pts = plan(base, 8, CostModel("v5p"))
+    mesh_pts = [p for p in pts if p.cfg.distributed.cp_flavor == "mesh"]
+    assert mesh_pts, "planner pruned every mesh candidate"
+    line = mesh_pts[0].overrides_line()
+    assert "distributed.cp_flavor=mesh" in line
+    assert "distributed.cp_mesh=" in line
+    assert "model.attn_impl=" in line
+
+
+def test_cli_cp_crossover_json(capsys):
+    import importlib.util
+    import json
+    import os
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    spec = importlib.util.spec_from_file_location(
+        "layout_planner", os.path.join(root, "tools", "layout_planner.py"))
+    lp = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lp)
+    rc = lp.main(["--cp-crossover", "--model", "meta-llama/Llama-3.1-8B",
+                  "--seq", "16384", "--json"])
+    assert rc == 0
+    rows = [json.loads(l) for l in
+            capsys.readouterr().out.strip().splitlines()]
+    assert {r["generation"] for r in rows} == set(GENERATIONS)
+    assert all(r["crossover_cp"] == 16 for r in rows)

@@ -37,6 +37,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ep", type=int, default=1,
                    help="expert parallelism (MoE models only)")
     p.add_argument("--pp-engine", default="1f1b", choices=["1f1b", "afab"])
+    p.add_argument("--cp-flavor", default=None,
+                   choices=["ring", "ulysses", "mesh"],
+                   help="context-parallel attention schedule for cp > 1 "
+                        "(default: ring, or whatever --attn-impl names); "
+                        "'mesh' factors cp into a 2D submesh — see "
+                        "--cp-mesh")
+    p.add_argument("--cp-mesh", default=None, metavar="XxY",
+                   help="mesh-flavor factorization cp = cp_x * cp_y, e.g. "
+                        "'2x4' (default: most-square feasible split; "
+                        "cp_y must divide the tp-local head counts)")
     p.add_argument("--sequence-parallel", action="store_true",
                    help="Megatron-SP over the tp axis (seq-sharded "
                         "residual stream between blocks)")
@@ -56,7 +66,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-attention-heads", type=int, default=None)
     p.add_argument("--num-key-value-heads", type=int, default=None)
     p.add_argument("--attn-impl", default="auto",
-                   choices=["auto", "flash", "reference", "ring", "ulysses"])
+                   choices=["auto", "flash", "reference", "ring",
+                            "ulysses", "mesh"])
     p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
     # training (ref: create_config.py --mbs/--grad-acc/--seq-len)
     p.add_argument("--mbs", type=int, default=1)
@@ -189,6 +200,8 @@ def create_single_config(args) -> str:
             "sequence_parallel": args.sequence_parallel,
             "zero1": args.zero1,
             "use_cpu": args.use_cpu,
+            **({"cp_flavor": args.cp_flavor} if args.cp_flavor else {}),
+            **({"cp_mesh": args.cp_mesh} if args.cp_mesh else {}),
         },
         "model": {
             "name": args.model, **preset, **model_overrides,

@@ -131,6 +131,14 @@ def main(argv=None) -> int:
                     help="one JSON line per ranked point")
     ap.add_argument("--markdown", action="store_true",
                     help="markdown table (PERF.md format)")
+    ap.add_argument("--cp-crossover", action="store_true",
+                    help="instead of planning, sweep cp degree and print "
+                         "each cp flavor's predicted step time per ICI "
+                         "generation, with the smallest cp degree where "
+                         "the 2D mesh flavor wins (its crossover)")
+    ap.add_argument("--cp-degrees", type=int, nargs="*", default=None,
+                    metavar="CP", help="cp degrees to sweep with "
+                         "--cp-crossover (default 2 4 8 16 32)")
     ap.add_argument("--validate-sweep", action="store_true",
                     help="score the cost model's rank agreement against "
                          "the measured SWEEP_r03-r05 rows instead of "
@@ -167,6 +175,42 @@ def main(argv=None) -> int:
                 print(f"    {r['metric']:42s} measured "
                       f"{r['measured_tps_chip']:>9} predicted "
                       f"{r['predicted_tps_chip']:>9} tok/s/chip")
+        return 0
+
+    if args.cp_crossover:
+        from picotron_tpu.analysis.cost_model import (
+            GENERATIONS, cp_crossover, cp_crossover_table,
+        )
+
+        base = build_base_config(args)
+        degrees = tuple(args.cp_degrees or (2, 4, 8, 16, 32))
+        out = []
+        for gen in GENERATIONS:
+            m = CostModel(gen)
+            out.append((gen, cp_crossover_table(m, base, degrees),
+                        cp_crossover(m, base, degrees)))
+        if args.json:
+            for gen, rows, cross in out:
+                print(json.dumps({"generation": gen, "rows": rows,
+                                  "crossover_cp": cross}), flush=True)
+            return 0
+        print(f"cp-flavor crossover: {base.model.name} seq "
+              f"{base.training.seq_length} (tp={base.distributed.tp_size},"
+              f" '-' = flavor infeasible at that degree)")
+        hdr = ("gen", "cp", "ring_ms", "ulysses_ms", "mesh_ms",
+               "mesh_fact", "winner")
+        print("  " + "  ".join(h.rjust(10) for h in hdr))
+        for gen, rows, cross in out:
+            for r in rows:
+                cells = (gen, r["cp"], r["ring_ms"],
+                         r.get("ulysses_ms") or "-",
+                         r.get("mesh_ms") or "-",
+                         r.get("mesh_factorization", "-"), r["winner"])
+                print("  " + "  ".join(str(c).rjust(10) for c in cells))
+        for gen, _, cross in out:
+            print(f"predicted mesh crossover on {gen}: "
+                  + (f"cp={cross}" if cross else
+                     "never (within swept degrees)"))
         return 0
 
     if not args.chips:

@@ -88,6 +88,20 @@ PRESETS: dict[str, tuple] = {
                        dict(gradient_accumulation_steps=2,
                             grad_engine="fused",
                             remat_policy="dots_attn")),
+    # the mesh cp flavor's 2D schedule (ops/mesh_attention.py): the audit
+    # must see the head-scatter all_to_all on the cp_y subgroup AND the
+    # row ring ppermute on the cp_x rows — and no collective widened to
+    # the full cp axis (collectives.py mesh presence rule)
+    "tiny-cp4-mesh": ("debug-tiny",
+                      dict(dp_size=2, cp_size=4, cp_flavor="mesh",
+                           cp_mesh="2x2"),
+                      dict(gradient_accumulation_steps=2)),
+    "tiny-cp4-mesh-fused": ("debug-tiny",
+                            dict(dp_size=2, cp_size=4, cp_flavor="mesh",
+                                 cp_mesh="2x2"),
+                            dict(gradient_accumulation_steps=2,
+                                 grad_engine="fused",
+                                 remat_policy="dots_attn")),
 }
 
 
