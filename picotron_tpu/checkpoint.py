@@ -428,6 +428,9 @@ class CheckpointManager:
         # canonical (no padding), so any-topology restore works; an uneven
         # split bakes its pp into the padded shape, which a different pp
         # cannot consume — fail with the story rather than a shape error.
+        # This is also the gate behind elastic pp resize: the guard above
+        # admits a pp mismatch (checkpoint.elastic), and this check is
+        # what restricts it to even splits that share the slot layout.
         src = meta.get("config", {})
         src_m, src_d = src.get("model", {}), src.get("distributed", {})
         if src_m.get("num_hidden_layers") and src_d.get("pp_size"):

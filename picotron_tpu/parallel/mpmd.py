@@ -65,6 +65,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from picotron_tpu import compat
 from picotron_tpu.config import Config
+from picotron_tpu.resilience import chaos, watchdog
 from picotron_tpu.mesh import MeshEnv
 from picotron_tpu.models.llama import (
     compute_dtype, embed, final_hidden, head_weight, model_rope_tables,
@@ -530,6 +531,14 @@ def _build_stages(cfg: Config, menv: MeshEnv):
             for j in range(V)]
 
 
+class ScheduleBufferError(RuntimeError):
+    """The schedule walk finished with live boundary buffers: some
+    dispatched op produced an activation/cotangent/saved-input that no
+    later op consumed. Always a schedule-table bug (truncated table,
+    broken dependency edge) — named so the diagnostic lists exactly
+    which (vstage, mb) keys were orphaned instead of a bare assert."""
+
+
 def _index_arrays(n_micro: int, sharding: NamedSharding):
     """The microbatch index feed, staged ONCE: n committed int32 scalars on
     the stage submesh. Re-minting them per step would be a host-to-device
@@ -539,10 +548,21 @@ def _index_arrays(n_micro: int, sharding: NamedSharding):
 
 
 def _run_schedule(stages, table, chunk_params, accs, state_scalars,
-                  ids_s, tgt_s, idx_first, idx_last, timings=None):
+                  ids_s, tgt_s, idx_first, idx_last, timings=None,
+                  step=None):
     """Walk the schedule table in (tick, group) order, dispatching stage
     programs and moving boundary tensors with explicit device_put. Returns
-    (accs, nll_acc, cnt_acc, per_microbatch_nll, per_microbatch_cnt)."""
+    (accs, nll_acc, cnt_acc, per_microbatch_nll, per_microbatch_cnt).
+
+    Mid-schedule fault surface: each dispatched op heartbeats the
+    watchdog with the live (stage, tick, op, mb) — a stall inside the
+    walk is reported as that op, not a bare stack dump — and calls the
+    `schedule_tick` chaos point so a `#TICK` event can deliver a
+    SIGTERM/hang at a named op. A SIGTERM landing mid-walk only sets the
+    preemption flag (the handler runs no consequential Python), so the
+    walk always drains to the step boundary: the emergency checkpoint
+    the driver then writes only ever contains fully-accumulated state,
+    never a half-walked schedule's partial grads."""
     V = len(stages)
     nll_acc, cnt_acc = state_scalars
     xbuf: dict = {}    # (vstage, mb) -> inbound activation
@@ -553,6 +573,12 @@ def _run_schedule(stages, table, chunk_params, accs, state_scalars,
     for op in table:
         j, mb = op.vstage, op.mb
         st = stages[j]
+        if watchdog.active():
+            watchdog.touch(f"pp_schedule stage={j} tick={op.tick} "
+                           f"op={op.op} mb={mb}", step)
+        if step is not None:
+            chaos.fire("schedule_tick", step=step,
+                       tick=op.tick, stage=j, op=op.op, mb=mb)
         t0 = time.perf_counter() if timings is not None else 0.0
         if op.op == "F":
             if st.first:
@@ -595,7 +621,16 @@ def _run_schedule(stages, table, chunk_params, accs, state_scalars,
                                    xbuf.get((j + 1, mb))))
             timings.setdefault(op.group, []).append(
                 time.perf_counter() - t0)
-    assert not xbuf and not gbuf and not xsave, "schedule left live buffers"
+    leftover = ([f"activation (vstage={j}, mb={m})" for j, m in sorted(xbuf)]
+                + [f"cotangent (vstage={j}, mb={m})" for j, m in sorted(gbuf)]
+                + [f"saved-input (vstage={j}, mb={m})"
+                   for j, m in sorted(xsave)])
+    if leftover:
+        raise ScheduleBufferError(
+            f"schedule walk left {len(leftover)} live boundary buffer(s) "
+            f"— the table dispatched ops that produced tensors no later "
+            f"op consumed (a truncated or dependency-broken table): "
+            f"{'; '.join(leftover)}")
     return accs, nll_acc, cnt_acc, mb_nll, mb_cnt
 
 
@@ -641,11 +676,20 @@ def make_mpmd_train_step(cfg: Config, menv: MeshEnv,
         ids_s = jax.device_put(ids, ids_sharding)
         tgt_s = jax.device_put(tgt, tgt_sharding)
         host_step[0] += 1
+        step_no = host_step[0]
+        if chaos.controller().has_tick_events():
+            # #TICK chaos keys on the TRAINING step number (identical on
+            # every process / across resumes); resolve it exactly via a
+            # host sync this path otherwise avoids. Without tick events
+            # the process-local invocation index is plenty for the
+            # watchdog's diagnostic beats.
+            step_no = int(jax.device_get(state.step)) + 1
         timings = ({} if on_stage_times is not None and sample > 0
                    and host_step[0] % sample == 0 else None)
         accs, nll_acc, cnt_acc, _, _ = _run_schedule(
             stages, table, chunk_params, accs, zero_scalars(),
-            ids_s, tgt_s, idx_first, idx_last, timings=timings)
+            ids_s, tgt_s, idx_first, idx_last, timings=timings,
+            step=step_no)
         if timings is not None and on_stage_times is not None:
             on_stage_times(timings, host_step[0])
         grads = tuple(

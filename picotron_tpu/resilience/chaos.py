@@ -2,7 +2,7 @@
 
 A chaos spec is a comma-separated list of events, each
 
-    KIND@STEP[xCOUNT][~SECS]
+    KIND@STEP[xCOUNT][~SECS][#TICK]
 
 - ``KIND``: one of ``sigterm`` / ``sigint`` (deliver that signal to this
   process at the start of step STEP — exercises the real preemption
@@ -25,9 +25,18 @@ A chaos spec is a comma-separated list of events, each
   checkpoint.latest_valid_step).
 - ``xCOUNT`` defaults to 1; ``~SECS`` defaults to 0 and is required for the
   sleep kinds.
+- ``#TICK`` (signal/sleep kinds only) moves the event INSIDE the MPMD
+  schedule walk: instead of firing at the start of step STEP, it fires at
+  the named schedule tick of that step's walk — the `schedule_tick` point
+  parallel/mpmd._run_schedule calls per dispatched op, with the live
+  (stage, tick, op) as context. This is how a preemption or hang is
+  injected mid-schedule rather than between steps; an event without
+  ``#TICK`` never fires there, and a ``#TICK`` event never fires at
+  step_begin.
 
 Examples: ``sigterm@3``, ``ckpt_io@2x2,nan_grad@4``, ``data_stall@3~10``,
-``ckpt_corrupt_bitflip@4,kill@5``.
+``ckpt_corrupt_bitflip@4,kill@5``, ``sigterm@3#2`` (mid-schedule),
+``hang@4~120#1``.
 
 The spec comes from ``resilience.chaos`` in the config; the
 ``PICOTRON_CHAOS`` environment variable, when set (even to the empty
@@ -50,6 +59,7 @@ import signal
 import sys
 import time
 from dataclasses import dataclass, field
+from typing import Optional
 
 KINDS = ("sigterm", "sigint", "kill", "hang", "ckpt_io", "data_io",
          "data_stall", "nan_grad", "ckpt_corrupt_bitflip", "ckpt_truncate",
@@ -63,15 +73,23 @@ KINDS = ("sigterm", "sigint", "kill", "hang", "ckpt_io", "data_io",
 # corruption kinds mutate a checkpoint the store considers good.
 _POINT_KINDS = {
     "step_begin": ("sigterm", "sigint", "kill", "hang"),
+    # inside the MPMD schedule walk (parallel/mpmd._run_schedule), one
+    # call per dispatched op with ctx (tick, stage, op, mb); only #TICK
+    # events fire here
+    "schedule_tick": ("sigterm", "sigint", "kill", "hang"),
     "ckpt_save": ("ckpt_io",),
     "data_produce": ("data_io", "data_stall"),
     "ckpt_committed": ("ckpt_corrupt_bitflip", "ckpt_truncate",
                        "ckpt_torn_meta"),
 }
 
+# Kinds that may carry a #TICK suffix (the schedule_tick-capable set).
+_TICK_KINDS = ("sigterm", "sigint", "kill", "hang")
+
 _EVENT_RE = re.compile(
     r"^(?P<kind>[a-z_]+)@(?P<step>\d+)"
-    r"(?:x(?P<count>\d+))?(?:~(?P<secs>\d+(?:\.\d+)?))?$")
+    r"(?:x(?P<count>\d+))?(?:~(?P<secs>\d+(?:\.\d+)?))?"
+    r"(?:#(?P<tick>\d+))?$")
 
 
 @dataclass
@@ -80,6 +98,7 @@ class ChaosEvent:
     step: int          # 1-based training step (or batch number for data_*)
     count: int = 1     # xN: firings before the event is exhausted
     secs: float = 0.0  # ~S: sleep duration for hang / data_stall
+    tick: Optional[int] = None  # #T: fire at this MPMD schedule tick
     fired: int = field(default=0, compare=False)
 
 
@@ -103,8 +122,15 @@ def parse_spec(spec: str) -> list[ChaosEvent]:
             raise ValueError(
                 f"chaos event {item!r} needs a ~SECS duration (e.g. "
                 f"{kind}@{m.group('step')}~5)")
+        tick = m.group("tick")
+        if tick is not None and kind not in _TICK_KINDS:
+            raise ValueError(
+                f"chaos event {item!r}: #TICK (mid-schedule injection) "
+                f"only applies to {_TICK_KINDS}, not {kind!r}")
         events.append(ChaosEvent(kind=kind, step=int(m.group("step")),
-                                 count=int(m.group("count") or 1), secs=secs))
+                                 count=int(m.group("count") or 1), secs=secs,
+                                 tick=int(tick) if tick is not None
+                                 else None))
     return events
 
 
@@ -114,16 +140,17 @@ def _log(msg: str) -> None:
     print(f"[chaos] {msg}", file=sys.stderr, flush=True)
 
 
-def _emit(e: "ChaosEvent", point: str, step: int) -> None:
+def _emit(e: "ChaosEvent", point: str, step: int, **ctx) -> None:
     # Record-only telemetry (no category: the injected fault's COST is
     # booked by whatever it disrupts — the stalled data phase, the retry
     # backoff, the rollback — so booking the injection too would
     # double-count). The event ties the booked badput to its cause in
-    # the JSONL stream.
+    # the JSONL stream; schedule_tick firings carry the live
+    # (stage, tick, op, mb) so a mid-schedule fault is addressable.
     from picotron_tpu.telemetry import bus
 
     bus.emit("chaos", chaos_kind=e.kind, point=point, step=step,
-             fired=e.fired, count=e.count)
+             fired=e.fired, count=e.count, **ctx)
 
 
 class ChaosController:
@@ -138,7 +165,14 @@ class ChaosController:
         return ", ".join(
             f"{e.kind}@{e.step}" + (f"x{e.count}" if e.count > 1 else "")
             + (f"~{e.secs:g}" if e.secs else "")
+            + (f"#{e.tick}" if e.tick is not None else "")
             for e in self.events)
+
+    def has_tick_events(self) -> bool:
+        """True when any event targets a schedule tick — the MPMD step
+        then resolves the exact training-step number for its walk (a
+        host sync it otherwise skips)."""
+        return any(e.tick is not None for e in self.events)
 
     def has_nan_grad(self) -> bool:
         """True when the spec names any nan_grad event — the driver then
@@ -168,15 +202,27 @@ class ChaosController:
         firing budget is not exhausted. May sleep, raise OSError, deliver
         a signal to this process, or corrupt committed bytes on disk
         (`ctx["path"]` carries the checkpoint step dir for the
-        ckpt_committed point)."""
+        ckpt_committed point). A #TICK event fires ONLY at the
+        schedule_tick point when `ctx["tick"]` matches; an event without
+        a tick never fires there — the two injection sites are disjoint
+        by construction."""
         for e in self.events:
             if (e.kind not in _POINT_KINDS.get(point, ())
                     or e.step != step or e.fired >= e.count):
                 continue
+            if point == "schedule_tick":
+                if e.tick is None or ctx.get("tick") != e.tick:
+                    continue
+            elif e.tick is not None:
+                continue
             e.fired += 1
-            _log(f"firing {e.kind} at {point} step {step} "
+            where = (f" (stage={ctx.get('stage')} tick={ctx.get('tick')} "
+                     f"op={ctx.get('op')} mb={ctx.get('mb')})"
+                     if point == "schedule_tick" else "")
+            _log(f"firing {e.kind} at {point} step {step}{where} "
                  f"({e.fired}/{e.count})")
-            _emit(e, point, step)
+            _emit(e, point, step, **{k: v for k, v in ctx.items()
+                                     if k in ("tick", "stage", "op", "mb")})
             if e.kind in ("sigterm", "sigint"):
                 os.kill(os.getpid(),
                         signal.SIGTERM if e.kind == "sigterm"

@@ -1,4 +1,4 @@
-"""Elastic scale-out: restore a dp=N checkpoint into a dp=M mesh.
+"""Elastic scale-out: restore a checkpoint into a resized dp / pp mesh.
 
 The resilience stack (preemption/watchdog/guards) and the verified
 checkpoint lineage (ckpt_integrity) recover a fixed-shape world: every
@@ -39,8 +39,19 @@ topology change safe:
   restore-to-template reshard IS the re-split; these helpers serve the
   offload layout and the parity proof.)
 
+Supported resize axes are SUPPORTED_ELASTIC_AXES = (dp, pp), alone or
+jointly. dp rides the constant-global-batch plan + ZeRO-1 re-split below;
+pp rides the padded global layer stack (pp_layer_placement pads to a
+common multiple, so every even-split pp stores the SAME stack — the
+restore-time slot-layout check in checkpoint.restore gates uneven splits)
+and the MPMD executor's rebuild-from-config startup (per-stage programs +
+schedule table are derived from the run config, boundary ring buffers are
+rebuilt per process start). pp does not enter `mbs * ga * dp * ep`, so a
+pure-pp resize holds global batch constant trivially. tp/cp/ep stay hard
+errors either way: nothing re-partitions the weight math they split.
+
 Two consumption flavors, both exercised by `tools/chaos.py --scenario
-dp_resize`:
+dp_resize` (and its pp twin `--scenario pp_resize`):
 
 1. **Offline re-stamp** (`tools/elastic_resize.py`): rewrite a verified
    step dir's meta.json for the new layout and re-commit its manifest.
@@ -65,6 +76,18 @@ import numpy as np
 # is derived (product of these); process_count is a launch detail Orbax
 # already absorbs (global arrays restore under any process->device map).
 TOPOLOGY_AXES = ("dp", "pp", "ep", "cp", "tp")
+
+# The axes a resize (offline re-stamp or checkpoint.elastic) can actually
+# carry a checkpoint across. dp: constant-global-batch re-factoring +
+# ZeRO-1 re-split (PR 11). pp: the padded global layer stack is identical
+# for every pp whose slot layout matches (even splits — the restore-time
+# pp_layer_placement check gates it), and the MPMD executor rebuilds its
+# per-stage programs + schedule table from config at startup, so no array
+# surgery is needed. tp/cp/ep re-partition WEIGHT math (head splits,
+# expert placement, sequence shards) that neither the re-stamp tool nor
+# Orbax's reshard validates — a mismatch there must fail loudly even when
+# elastic is on, never proceed into an unsupported restore.
+SUPPORTED_ELASTIC_AXES = ("dp", "pp")
 
 
 def topology_from_distributed(dist) -> dict:
@@ -121,11 +144,17 @@ def topology_mismatch(saved: Optional[dict],
             and int(saved[ax]) != int(current[ax])]
 
 
-def resize_invocation(save_dir: str, step: int, dp_new: int) -> str:
+def resize_invocation(save_dir: str, step: int, current: dict,
+                      axes=("dp",)) -> str:
     """The offline re-stamp command that would adapt the checkpoint to
-    this run's shape — quoted verbatim in the mismatch RuntimeError."""
+    this run's shape — quoted verbatim in the mismatch RuntimeError.
+    Renders a flag per ACTUALLY-mismatched supported axis (a pure-pp
+    mismatch must print a `--pp` line, not a `--dp` no-op that would not
+    fix it)."""
+    flags = " ".join(f"--{ax} {int(current[ax])}"
+                     for ax in SUPPORTED_ELASTIC_AXES if ax in axes)
     return (f"python tools/elastic_resize.py {save_dir} "
-            f"--step {step} --dp {dp_new}")
+            f"--step {step} {flags}".rstrip())
 
 
 # ---------------------------------------------------------------------------
@@ -297,20 +326,38 @@ def check_restore_topology(step_dir: str, meta: dict, cfg,
     checkpoint recorded none — pre-lineage stores keep restoring).
     On a mismatch:
 
+    - any axis outside SUPPORTED_ELASTIC_AXES ({dp, pp}) differs:
+      RuntimeError naming the unsupported axis — `checkpoint.elastic`
+      cannot authorize a tp/cp/ep change, because nothing re-partitions
+      the weight math those axes split.
     - `checkpoint.elastic` off: RuntimeError naming both topologies and
-      the `tools/elastic_resize.py` invocation that would re-stamp the
-      checkpoint for this mesh — a changed fleet shape must never resume
-      silently wrong.
-    - `checkpoint.elastic` on: validate the constant-global-batch
-      invariant (raising with the exact overrides that restore it when
-      violated) and return the resize record
-      {"from", "to", "axes"} for the caller to book/emit.
+      the `tools/elastic_resize.py` invocation (flags for the
+      actually-mismatched axes) that would re-stamp the checkpoint for
+      this mesh — a changed fleet shape must never resume silently wrong.
+    - `checkpoint.elastic` on, mismatch within {dp, pp}: validate the
+      constant-global-batch invariant (raising with the exact overrides
+      that restore it when violated) and return the resize record
+      {"from", "to", "axes"} for the caller to book/emit. A pp change is
+      additionally gated by the padded-layer-stack slot check in
+      checkpoint.restore (even splits only), which runs right after this
+      guard.
     """
     saved = saved_topology(step_dir)
     current = topology_from_distributed(cfg.distributed)
     axes = topology_mismatch(saved, current)
     if not axes:
         return None
+    unsupported = [ax for ax in axes if ax not in SUPPORTED_ELASTIC_AXES]
+    if unsupported:
+        raise RuntimeError(
+            f"checkpoint step {step} under {save_dir} was saved at "
+            f"topology [{describe_topology(saved)}] but this run's mesh "
+            f"is [{describe_topology(current)}] (mismatched axes: "
+            f"{', '.join(axes)}); axis "
+            f"{'/'.join(unsupported)} is not elastic-resizable "
+            f"(supported: {', '.join(SUPPORTED_ELASTIC_AXES)}) — "
+            f"checkpoint.elastic cannot reshard across it. Restore on "
+            f"the saved {'/'.join(unsupported)} size")
     if not getattr(cfg.checkpoint, "elastic", False):
         raise RuntimeError(
             f"checkpoint step {step} under {save_dir} was saved at "
@@ -319,7 +366,7 @@ def check_restore_topology(step_dir: str, meta: dict, cfg,
             f"{', '.join(axes)}); refusing to resume silently across a "
             f"topology change. Either restore on the saved topology, "
             f"re-stamp the checkpoint offline with\n"
-            f"  {resize_invocation(save_dir, step, current['dp'])}\n"
+            f"  {resize_invocation(save_dir, step, current, axes)}\n"
             f"or set checkpoint.elastic=true to reshard at restore time "
             f"(global batch must stay constant)")
     gbs_saved = saved_global_batch(meta)

@@ -6,7 +6,12 @@ the machine disappears. The handler only records the request (signal
 handlers must not run Python of any consequence — the main thread may be
 inside an XLA dispatch); the step loop polls `triggered` after each step,
 finishes the in-flight step, writes an emergency checkpoint including the
-dataloader position, and exits `EXIT_PREEMPTED`. A supervisor that
+dataloader position, and exits `EXIT_PREEMPTED`. This record-only design
+is also what makes a MID-SCHEDULE preemption safe on the MPMD executor:
+a SIGTERM landing inside the schedule walk (parallel/mpmd._run_schedule;
+chaos can inject one at a named (stage, tick, op) via `sigterm@N#T`)
+merely sets the flag, so the walk drains to the step boundary and the
+emergency checkpoint never persists half-accumulated gradients. A supervisor that
 resubmits the same config with `checkpoint.auto_resume` then continues
 losslessly — no replayed data, no lost steps.
 

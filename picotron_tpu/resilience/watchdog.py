@@ -31,10 +31,19 @@ EXIT_WATCHDOG = 77
 _ACTIVE: list["Watchdog"] = []
 
 
-def touch(phase: str = "touch") -> None:
-    """Beat every active watchdog (no-op when none is armed)."""
+def touch(phase: str = "touch", step: Optional[int] = None) -> None:
+    """Beat every active watchdog (no-op when none is armed). The MPMD
+    schedule walk beats here once per dispatched op with a phase naming
+    the live (stage, tick, op), so a mid-schedule stall is reported as
+    that op, not a bare stack dump."""
     for w in list(_ACTIVE):
-        w.beat(phase)
+        w.beat(phase, step)
+
+
+def active() -> bool:
+    """True when any watchdog is armed — lets hot loops skip building
+    per-op phase strings no one would read."""
+    return bool(_ACTIVE)
 
 
 class Watchdog:

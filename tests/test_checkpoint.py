@@ -214,12 +214,13 @@ def test_gc_keeps_last_verified_with_keep_last_1(tmp_path):
 
 
 def test_restore_across_topologies(tmp_path):
-    """Save under dp=2,tp=2 / restore under dp=1,tp=4 with
+    """Save under dp=2,tp=2 / restore under dp=1,tp=2 with
     checkpoint.elastic on: Orbax reshards into the template's shardings —
     the reference hard-fails on this (ref: checkpoint.py:263 resume
     assumes identical topology). Gradient accumulation doubles so the
     global batch is unchanged (the elastic invariant); the restore must
-    surface the resize record it booked."""
+    surface the resize record it booked. Only dp/pp are resizable —
+    changing tp here would be a hard error (see test_elastic.py)."""
     import dataclasses
 
     cfg_a = make_cfg(tmp_path, dp_size=2, tp_size=2)
@@ -227,7 +228,7 @@ def test_restore_across_topologies(tmp_path):
     state = init_sharded_state(cfg_a, menv_a, jax.random.key(0))
     CheckpointManager(cfg_a, menv_a).save(state)
 
-    cfg_b = make_cfg(tmp_path, tp_size=4)
+    cfg_b = make_cfg(tmp_path, tp_size=2)
     cfg_b = dataclasses.replace(
         cfg_b,
         training=dataclasses.replace(cfg_b.training,
@@ -243,7 +244,7 @@ def test_restore_across_topologies(tmp_path):
     # restored arrays carry the *new* topology's shardings
     assert restored.params["layers"]["q"].sharding == template.params["layers"]["q"].sharding
     resize = meta["elastic_resize"]
-    assert sorted(resize["axes"]) == ["dp", "tp"]
+    assert resize["axes"] == ["dp"]
     assert resize["from"]["dp"] == 2 and resize["to"]["dp"] == 1
 
 
@@ -256,15 +257,16 @@ def test_restore_topology_mismatch_raises_without_elastic(tmp_path):
     state = init_sharded_state(cfg_a, menv_a, jax.random.key(0))
     CheckpointManager(cfg_a, menv_a).save(state)
 
-    cfg_b = make_cfg(tmp_path, tp_size=4)
+    cfg_b = make_cfg(tmp_path, tp_size=2)
     menv_b = MeshEnv.from_config(cfg_b)
     template = init_sharded_state(cfg_b, menv_b, jax.random.key(1))
     with pytest.raises(RuntimeError) as exc:
         CheckpointManager(cfg_b, menv_b).restore(template)
     msg = str(exc.value)
     assert "dp2 pp1 ep1 cp1 tp2" in msg    # saved topology
-    assert "dp1 pp1 ep1 cp1 tp4" in msg    # this run's mesh
+    assert "dp1 pp1 ep1 cp1 tp2" in msg    # this run's mesh
     assert "tools/elastic_resize.py" in msg
+    assert "--dp 1" in msg                 # re-stamp to the run's mesh
     assert "checkpoint.elastic" in msg
 
 

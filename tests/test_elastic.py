@@ -267,6 +267,162 @@ def test_elastic_resize_tool_refuses_corrupt_store(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# pp / joint dp x pp elastic allow-path
+# ---------------------------------------------------------------------------
+
+
+def _fake_step(tmp_path, *, mbs=1, ga=1, **dist):
+    """A legacy (meta.json-only) step dir recording a saved topology —
+    enough for check_restore_topology, without a real Orbax store."""
+    step = tmp_path / "saved" / "step_00000002"
+    step.mkdir(parents=True)
+    meta = {"config": {
+        "distributed": {f"{ax}_size": int(dist.get(f"{ax}_size", 1))
+                        for ax in elastic.TOPOLOGY_AXES},
+        "training": {"micro_batch_size": mbs,
+                     "gradient_accumulation_steps": ga},
+    }}
+    (step / "meta.json").write_text(json.dumps(meta))
+    return str(step), meta
+
+
+def test_restore_topology_pp_allow_path(tmp_path):
+    """A pure-pp mismatch rides the elastic allow-path: pp does not enter
+    the global batch, so the resize record comes back with no batch
+    re-plan needed (the padded-layer-stack slot check in
+    checkpoint.restore gates even splits separately)."""
+    step_dir, meta = _fake_step(tmp_path, mbs=2, ga=2, pp_size=2)
+    cfg = make_cfg(tmp_path, mbs=2, ga=2, elastic_on=True)  # pp=1 mesh
+    rec = elastic.check_restore_topology(
+        step_dir, meta, cfg, step=2, save_dir=str(tmp_path / "saved"))
+    assert rec["axes"] == ["pp"]
+    assert rec["from"]["pp"] == 2 and rec["to"]["pp"] == 1
+
+
+def test_restore_topology_joint_dp_pp_allow_path(tmp_path):
+    """dp and pp resize jointly: the record names both axes and the
+    constant-global-batch invariant is still enforced through the dp
+    half (mbs x ga x dp unchanged)."""
+    step_dir, meta = _fake_step(tmp_path, mbs=1, ga=1, dp_size=2,
+                                pp_size=2)                       # gbs 2
+    cfg = make_cfg(tmp_path, dp_size=1, mbs=1, ga=2,             # gbs 2
+                   elastic_on=True)
+    rec = elastic.check_restore_topology(
+        step_dir, meta, cfg, step=2, save_dir=str(tmp_path / "saved"))
+    assert rec["axes"] == ["dp", "pp"]
+
+    # same joint mismatch with elastic OFF: the error names both axes
+    # and quotes a re-stamp invocation carrying BOTH flags
+    cfg_off = make_cfg(tmp_path, dp_size=1, mbs=1, ga=2)
+    with pytest.raises(RuntimeError, match="dp, pp") as exc:
+        elastic.check_restore_topology(
+            step_dir, meta, cfg_off, step=2,
+            save_dir=str(tmp_path / "saved"))
+    assert "--dp 1" in str(exc.value) and "--pp 1" in str(exc.value)
+
+
+def test_restore_topology_pure_pp_mismatch_renders_pp_flag(tmp_path):
+    """The elastic-off error for a pure-pp mismatch must quote a --pp
+    re-stamp line, not a --dp no-op that would not fix it."""
+    step_dir, meta = _fake_step(tmp_path, mbs=2, ga=2, pp_size=2)
+    cfg = make_cfg(tmp_path, mbs=2, ga=2)  # pp=1 mesh, elastic off
+    with pytest.raises(RuntimeError) as exc:
+        elastic.check_restore_topology(
+            step_dir, meta, cfg, step=2, save_dir=str(tmp_path / "saved"))
+    assert "--pp 1" in str(exc.value)
+    assert "--dp" not in str(exc.value)
+
+
+@pytest.mark.parametrize("axis", ["tp", "cp", "ep"])
+def test_restore_topology_rejects_unsupported_axis_even_elastic(
+        tmp_path, axis):
+    """The allow-path is {dp, pp} ONLY: nothing re-partitions the weight
+    math tp/cp/ep split, so a mismatch there must raise even with
+    checkpoint.elastic on — never proceed into an unsupported reshard."""
+    step_dir, meta = _fake_step(tmp_path, mbs=2, ga=1,
+                                **{f"{axis}_size": 2})
+    cfg = make_cfg(tmp_path, mbs=2, ga=1, elastic_on=True)  # all axes 1
+    with pytest.raises(RuntimeError, match="not elastic-resizable") as exc:
+        elastic.check_restore_topology(
+            step_dir, meta, cfg, step=2, save_dir=str(tmp_path / "saved"))
+    assert axis in str(exc.value)
+
+
+def test_resize_invocation_renders_mismatched_axes():
+    """The quoted re-stamp command renders a flag per ACTUALLY-mismatched
+    supported axis (regression: it used to always print --dp)."""
+    cur = {"dp": 4, "pp": 2}
+    pp_only = elastic.resize_invocation("/s", 3, cur, axes=("pp",))
+    assert pp_only.endswith("--pp 2") and "--dp" not in pp_only
+    both = elastic.resize_invocation("/s", 3, cur, axes=("dp", "pp"))
+    assert "--dp 4" in both and "--pp 2" in both
+    dp_only = elastic.resize_invocation("/s", 3, cur)
+    assert "--dp 4" in dp_only and "--pp" not in dp_only
+
+
+def test_elastic_resize_tool_restamps_pp(tmp_path):
+    """--pp on the offline tool: an uneven split is refused (store
+    untouched, slot mismatch named), an even split re-stamps pp as pure
+    metadata — the batch plan is untouched when --dp is absent — and the
+    re-stamped store restores on a pp=1 mesh with elastic OFF,
+    byte-identical params."""
+    cfg_a = make_cfg(tmp_path, pp_size=2, mbs=2, ga=2)
+    state = _save_step(cfg_a)
+    save_dir = cfg_a.checkpoint.save_dir
+    [step_dir] = [os.path.join(save_dir, d) for d in os.listdir(save_dir)
+                  if d.startswith("step_")]
+    tool = _load_tool()
+
+    # uneven split: 4 layers pad to 4 slots at pp=2 but 6 at pp=3 —
+    # refused before anything is rewritten
+    before = open(os.path.join(step_dir, "meta.json")).read()
+    assert tool.main([save_dir, "--pp", "3"]) == 1
+    assert open(os.path.join(step_dir, "meta.json")).read() == before
+
+    assert tool.main([save_dir, "--pp", "1"]) == 0
+    meta = json.load(open(os.path.join(step_dir, "meta.json")))
+    assert meta["config"]["distributed"]["pp_size"] == 1
+    # pure-pp: the batch plan is untouched
+    assert meta["config"]["training"]["micro_batch_size"] == 2
+    assert meta["config"]["training"]["gradient_accumulation_steps"] == 2
+    assert meta["elastic_restamp"]["to"]["pp"] == 1
+    topo = elastic.saved_topology(step_dir)
+    assert topo["pp"] == 1 and topo["world_size"] == 1
+
+    from picotron_tpu.ckpt_integrity import verify_step_dir
+    assert verify_step_dir(step_dir).status == "verified"
+
+    cfg_b = make_cfg(tmp_path, pp_size=1, mbs=2, ga=2)
+    menv_b = MeshEnv.from_config(cfg_b)
+    template = init_sharded_state(cfg_b, menv_b, jax.random.key(1))
+    restored, meta2 = CheckpointManager(cfg_b, menv_b).restore(template)
+    assert "elastic_resize" not in meta2
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["embedding"]),
+        np.asarray(state.params["embedding"]))
+
+
+def test_elastic_resize_tool_restamps_joint_dp_pp(tmp_path):
+    """--dp and --pp together: the dp half re-factors the batch at
+    constant global batch, the pp half re-stamps the stage count, and one
+    manifest re-commit covers both."""
+    cfg_a = make_cfg(tmp_path, dp_size=2, pp_size=2, mbs=2, ga=1)  # gbs 4
+    _save_step(cfg_a)
+    save_dir = cfg_a.checkpoint.save_dir
+    [step_dir] = [os.path.join(save_dir, d) for d in os.listdir(save_dir)
+                  if d.startswith("step_")]
+    tool = _load_tool()
+    assert tool.main([save_dir, "--dp", "1", "--pp", "1"]) == 0
+    meta = json.load(open(os.path.join(step_dir, "meta.json")))
+    assert meta["config"]["distributed"]["dp_size"] == 1
+    assert meta["config"]["distributed"]["pp_size"] == 1
+    assert meta["config"]["training"]["micro_batch_size"] == 2
+    assert meta["config"]["training"]["gradient_accumulation_steps"] == 2
+    topo = elastic.saved_topology(step_dir)
+    assert topo["dp"] == 1 and topo["pp"] == 1 and topo["world_size"] == 1
+
+
+# ---------------------------------------------------------------------------
 # ckpt_doctor source-topology column
 # ---------------------------------------------------------------------------
 

@@ -323,3 +323,117 @@ def test_mpmd_stage_programs_proven_interleaved():
     rep = prove_mpmd_stages(cfg)
     assert rep.ok(), rep.render(verbose=True)
     assert rep.info["variants"]["programs"] == 8  # 4 virtual stages x f/b
+
+
+# ---------------------------------------------------------------------------
+# elastic pp resize: schedule rebuild + mid-schedule fault surface
+# ---------------------------------------------------------------------------
+
+
+def test_schedule_rebuild_across_stage_counts():
+    """The elastic pp-resize contract: the schedule table derives purely
+    from (schedule, n_micro, pp) config — a resized run rebuilds a valid
+    table for the new stage count with no carried state, at every stage
+    count the resize saga visits."""
+    for pp in (2, 4):
+        for n in (2, 4, 8):
+            table = build_schedule("1f1b", n, pp)
+            check_schedule(table, "1f1b", n, pp)
+            assert len({op.group for op in table}) == pp
+
+
+class _FakeStage:
+    """Host-side stand-in for _StagePrograms (first/last of a pp=2
+    pipeline): numpy math, no compiled programs — lets the schedule-walk
+    mechanics (buffer lifecycle, heartbeats, chaos ticks, the orphan
+    diagnostic) run without building a mesh."""
+
+    x_sharding = None
+
+    def __init__(self, first, last):
+        self.first, self.last = first, last
+
+    def fwd(self, params, *a):
+        if self.first:
+            return np.float32(1.0)  # boundary activation
+        nll_acc, cnt_acc = a[-2], a[-1]  # last: (x, tgt, idx, nll, cnt)
+        return (np.float32(0.5), np.int32(4),
+                nll_acc + np.float32(0.5), cnt_acc + np.int32(4))
+
+    def bwd(self, params, *a):
+        acc = a[-1]
+        if self.first:  # (ids, idx, g_in, acc) -> acc
+            return acc + 1
+        return acc + 1, np.float32(0.1)  # (x, tgt, idx, acc) -> acc, g_x
+
+
+def _fake_walk(table, step=None):
+    from picotron_tpu.parallel import mpmd
+
+    stages = [_FakeStage(True, False), _FakeStage(False, True)]
+    return mpmd._run_schedule(
+        stages, table, [None, None], [0, 0],
+        (np.float32(0.0), np.int32(0)), None, None, [0, 1], [0, 1],
+        step=step)
+
+
+def test_schedule_walk_names_orphaned_buffers():
+    """A truncated table (the final stage-0 backward dropped) leaves its
+    inbound cotangent live: the walk must raise the named diagnostic
+    listing exactly the orphaned (vstage, mb) keys — not a bare assert."""
+    from picotron_tpu.parallel import mpmd
+
+    table = build_schedule("1f1b", 2, 2)
+    accs, nll, cnt, _, _ = _fake_walk(table)  # full table: clean walk
+    assert accs == [2, 2] and float(nll) == 1.0 and int(cnt) == 8
+
+    drop = max(i for i, op in enumerate(table)
+               if op.op == "B" and op.vstage == 0)
+    mb = table[drop].mb
+    with pytest.raises(mpmd.ScheduleBufferError) as exc:
+        _fake_walk(table[:drop] + table[drop + 1:])
+    msg = str(exc.value)
+    assert "live boundary buffer" in msg
+    assert f"cotangent (vstage=0, mb={mb})" in msg
+
+
+def test_sigterm_mid_walk_drains_to_step_boundary():
+    """A SIGTERM delivered at a named (stage, tick, op) inside the walk
+    only sets the preemption flag — the walk drains to the step boundary
+    and returns complete accumulators, so the emergency checkpoint the
+    driver then writes never sees half-accumulated grads."""
+    from picotron_tpu.resilience import chaos
+    from picotron_tpu.resilience.preemption import PreemptionHandler
+
+    table = build_schedule("1f1b", 2, 2)
+    tick = table[len(table) // 2].tick  # a mid-walk tick
+    chaos.install(f"sigterm@7#{tick}")
+    try:
+        with PreemptionHandler() as ph:
+            accs, nll, cnt, _, _ = _fake_walk(table, step=7)
+            assert ph.triggered  # the signal landed mid-walk...
+        # ...but the walk drained: full gradient accumulation, every
+        # boundary buffer consumed (no ScheduleBufferError)
+        assert accs == [2, 2] and int(cnt) == 8
+    finally:
+        chaos.install("")
+
+
+def test_watchdog_beat_names_live_schedule_op():
+    """Each dispatched op heartbeats the armed watchdog with a phase
+    naming the live (stage, tick, op, mb) — a mid-schedule stall is
+    reported as that op, not a bare stack dump."""
+    import re
+
+    from picotron_tpu.resilience import watchdog
+
+    w = watchdog.Watchdog(timeout=60.0)
+    w.start()
+    try:
+        _fake_walk(build_schedule("1f1b", 2, 2), step=3)
+        _t, phase, step = w._last
+        assert re.fullmatch(r"pp_schedule stage=\d+ tick=\d+ op=\w+ mb=\d+",
+                            phase), phase
+        assert step == 3
+    finally:
+        w.stop()

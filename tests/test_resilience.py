@@ -56,10 +56,45 @@ def test_chaos_spec_parses_all_fields():
     "ckpt_io@x",      # non-numeric step
     "data_stall@3",   # sleep kind without ~SECS
     "hang@2~0",       # zero-duration sleep
+    "ckpt_io@2#1",    # #TICK on a kind with no schedule_tick meaning
+    "nan_grad@3#2",   # ditto — poison is a step property, not an op one
 ])
 def test_chaos_spec_rejects_malformed(bad):
     with pytest.raises(ValueError):
         chaos.parse_spec(bad)
+
+
+def test_chaos_spec_parses_tick_suffix():
+    """`KIND@STEP#TICK` addresses a named schedule tick inside the MPMD
+    walk; the suffix composes with ~SECS and is None when absent."""
+    evs = chaos.parse_spec("sigterm@3#2,hang@4~120#1,kill@5")
+    assert [(e.kind, e.step, e.tick) for e in evs] == [
+        ("sigterm", 3, 2), ("hang", 4, 1), ("kill", 5, None)]
+    assert evs[1].secs == 120.0
+    ctrl = chaos.ChaosController(evs)
+    assert ctrl.has_tick_events()
+    assert "#2" in ctrl.describe() and "#1" in ctrl.describe()
+    assert not chaos.ChaosController(
+        chaos.parse_spec("sigterm@3")).has_tick_events()
+
+
+def test_chaos_tick_events_fire_only_at_matching_schedule_tick():
+    """The two injection sites are disjoint: a #TICK event ignores
+    step_begin and non-matching ticks; an event WITHOUT a tick never
+    fires at schedule_tick (it would double-fire with step_begin)."""
+    ctrl = chaos.ChaosController(
+        chaos.parse_spec("hang@2~0.01#3,ckpt_io@2x1"))
+    tick_ev = next(e for e in ctrl.events if e.kind == "hang")
+    ctrl.fire("step_begin", step=2)          # #3 event: not its point
+    assert tick_ev.fired == 0
+    ctrl.fire("schedule_tick", step=2, tick=1, stage=0, op="F", mb=0)
+    assert tick_ev.fired == 0                # wrong tick
+    ctrl.fire("schedule_tick", step=1, tick=3, stage=0, op="F", mb=0)
+    assert tick_ev.fired == 0                # wrong step
+    # the tick-less ckpt_io event must NOT raise here either — it is
+    # bound to its own points, and never to schedule_tick
+    ctrl.fire("schedule_tick", step=2, tick=3, stage=1, op="B", mb=1)
+    assert tick_ev.fired == 1                # the named (step, tick)
 
 
 def test_chaos_io_event_fires_count_times_then_exhausts():
@@ -540,7 +575,8 @@ def test_chaos_cli_lists_every_scenario(capsys):
     assert cli.main(["--list"]) == 0
     out = capsys.readouterr().out
     for name in ("sigterm", "ckpt_io", "nan_skip", "nan_rollback",
-                 "data_stall", "ckpt_corrupt_bitflip", "dp_resize"):
+                 "data_stall", "ckpt_corrupt_bitflip", "dp_resize",
+                 "pp_resize", "mpmd_sigterm"):
         assert name in out
 
 
@@ -634,3 +670,54 @@ def test_chaos_dp_resize_scenario(tmp_path):
     assert s["steps"]["replayed"] == 0
     assert s["categories"].get("resize", 0.0) > 0
     assert s["resize"]["events"] >= 1
+
+
+def _load_telemetry_report():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report", os.path.join(os.path.dirname(__file__), "..",
+                                         "tools", "telemetry_report.py"))
+    rep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rep)
+    return rep
+
+
+@pytest.mark.slow
+def test_chaos_pp_resize_scenario(tmp_path):
+    """Elastic PIPELINE resize, the full multi-process scenario: pp=2
+    MPMD SIGKILLed, re-stamped to pp=1 offline (--pp), SIGKILLed again,
+    finished at pp=2 via checkpoint.elastic. run_pp_resize itself asserts
+    loss-trajectory parity, final step/tokens, the resize booking, and
+    the compile-once prover pin on the rebuilt stage programs; here we
+    additionally pin zero replay across the whole saga."""
+    cli = _load_chaos_cli()
+    assert cli.run_pp_resize(str(tmp_path))
+
+    rep = _load_telemetry_report()
+    stream = os.path.join(tmp_path, "fault", "ckpt", "telemetry.jsonl")
+    s = rep.summarize(rep.load_events(stream))
+    assert s["steps"]["count"] == cli.STEPS
+    assert s["steps"]["max"] == cli.STEPS
+    assert s["steps"]["replayed"] == 0
+    assert s["categories"].get("resize", 0.0) > 0
+    assert s["resize"]["events"] >= 1
+
+
+@pytest.mark.slow
+def test_chaos_mpmd_sigterm_scenario(tmp_path):
+    """Mid-schedule fault hardening, the full multi-process scenario:
+    SIGTERM at a named (stage, tick, op) drains to the step boundary
+    (emergency ckpt, exit 75) and resumes losslessly; a forced
+    mid-schedule hang is watchdog-reported naming the live op. The
+    runner asserts the log markers; here we re-pin the zero-replay claim
+    on the sigterm leg's telemetry stream."""
+    cli = _load_chaos_cli()
+    assert cli.run_mpmd_sigterm(str(tmp_path))
+
+    rep = _load_telemetry_report()
+    stream = os.path.join(tmp_path, "sigterm", "ckpt", "telemetry.jsonl")
+    s = rep.summarize(rep.load_events(stream))
+    assert s["steps"]["count"] == cli.STEPS
+    assert s["steps"]["max"] == cli.STEPS
+    assert s["steps"]["replayed"] == 0

@@ -577,6 +577,33 @@ def test_report_render_text_and_markdown(tmp_path):
     assert "chaos=1" in md
 
 
+def test_report_resize_row_includes_pp_resizes(tmp_path):
+    """The resize row aggregates elastic_resize events regardless of
+    axis: a pp resize (and a joint dp x pp one) must surface in
+    `resize.events` with its restore seconds booked under the `resize`
+    category — the accounting contract the pp_resize chaos scenario
+    asserts end-to-end."""
+    rep = load_report()
+    p = tmp_path / "telemetry.jsonl"
+    _write_events(p, [
+        {"ts": 1.0, "kind": "phase", "phase": "step", "step": 1,
+         "category": "compute", "secs": 3.0},
+        {"ts": 2.0, "kind": "phase", "phase": "resize", "step": None,
+         "category": "resize", "secs": 2.0},
+        {"ts": 3.0, "kind": "elastic_resize", "step": 4, "axes": ["pp"],
+         "from": {"dp": 1, "pp": 1}, "to": {"dp": 1, "pp": 2}},
+        {"ts": 4.0, "kind": "phase", "phase": "resize", "step": None,
+         "category": "resize", "secs": 0.5},
+        {"ts": 5.0, "kind": "elastic_resize", "step": 5,
+         "axes": ["dp", "pp"], "from": {"dp": 2, "pp": 2},
+         "to": {"dp": 1, "pp": 1}},
+    ])
+    s = rep.summarize(rep.load_events(str(p)))
+    assert s["resize"]["events"] == 2
+    assert s["resize"]["seconds"] == pytest.approx(2.5)
+    assert s["categories"]["resize"] == pytest.approx(2.5)
+
+
 def test_report_pipeline_row(tmp_path):
     """A pp run's stream: pp_bubble events + per-stage section histograms
     in the run_summary must surface as the pipeline row (bubble share of

@@ -29,6 +29,17 @@ Scenarios (the runtime-failure matrix README "Fault tolerance" documents):
                 final step/tokens AND the per-step loss trajectory must
                 match the fault-free dp=2 baseline, and the resize
                 seconds must land in the `resize` goodput category
+  pp_resize     elastic pipeline resize: pp=2 MPMD run SIGKILLed,
+                re-stamped to pp=1 offline (tools/elastic_resize.py
+                --pp), killed again, then restored into a pp=2 MPMD
+                mesh via checkpoint.elastic — same loss-parity /
+                resize-booking bar as dp_resize, plus the PR-9 prover
+                pins every rebuilt stage program compiles exactly once
+  mpmd_sigterm  mid-schedule faults on the MPMD executor: SIGTERM at a
+                named (stage, tick, op) drains the schedule walk to the
+                step boundary (emergency ckpt, exit 75, zero replayed
+                steps on resume); a forced mid-schedule hang is
+                watchdog-reported naming the live (stage, tick, op)
 
 Usage:
 
@@ -295,6 +306,335 @@ def run_dp_resize(workdir: str, verbose: bool = False) -> bool:
     return True
 
 
+def run_pp_resize(workdir: str, verbose: bool = False) -> bool:
+    """Elastic PIPELINE resize — the dp_resize story on the pp axis.
+
+    pp does not enter the global batch (mbs x ga x dp x ep), so every leg
+    keeps mbs=2 ga=2 dp=1 untouched; what changes is the stage layout:
+
+      baseline  pp=2 MPMD (per-stage programs), fault-free, steps 1-6
+      leg 1     pp=2 MPMD, SIGKILL at step-3 begin (sync save @2 durable)
+      re-stamp  tools/elastic_resize.py --pp 1 rewrites the store offline
+                (even split: debug-tiny's 4 layers pad identically at
+                pp=1 and pp=2, so the stack is shared — metadata only)
+      leg 2     pp=1, the plain SPMD executor (config forbids MPMD at
+                pp=1), elastic OFF — the re-stamped store simply IS a
+                pp=1 checkpoint. SIGKILL at step-5 begin (save @4)
+      leg 3     pp=2 MPMD again, checkpoint.elastic=true — the runtime
+                elastic path restores the pp=1-stamped step 4 into a
+                pp=2 mesh; the executor rebuilds stage programs and the
+                schedule table from config and trains to completion
+
+    Same acceptance bar as dp_resize (per-step loss parity vs baseline,
+    final step/tokens equal, resize seconds + event booked) plus the
+    MPMD-specific pin: the PR-9 prover re-proves the rebuilt pp=2 stage
+    programs compile exactly once after the resize."""
+    import numpy as np
+
+    fail = lambda msg: (print(f"[chaos-cli] pp_resize: FAIL — {msg}"),  # noqa: E731
+                        False)[1]
+
+    def leg_config(ckpt_dir: str, *, pp: int, chaos_spec: str = "",
+                   elastic: bool = False) -> dict:
+        cfg = scenario_config(os.path.dirname(ckpt_dir), chaos_spec,
+                              {"checkpoint": {"async_save": False}})
+        cfg["distributed"].update(dp_size=1, tp_size=1, pp_size=pp)
+        cfg["training"]["micro_batch_size"] = 2
+        cfg["training"]["gradient_accumulation_steps"] = 2
+        if pp > 1:
+            cfg["pipeline"] = {"executor": "mpmd"}
+        cfg["checkpoint"]["save_dir"] = ckpt_dir
+        if elastic:
+            cfg["checkpoint"]["elastic"] = True
+        return cfg
+
+    def run_leg(cfg: dict, cfg_name: str, leg_dir: str) -> tuple[int, str]:
+        cfg_path = os.path.join(leg_dir, cfg_name)
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+        return (_run_trainer(cfg_path, os.path.join(leg_dir, "run.log"),
+                             {}), cfg_path)
+
+    # Fault-free pp=2 MPMD baseline: the trajectory every leg must hold.
+    base_dir = os.path.join(workdir, "baseline")
+    os.makedirs(base_dir, exist_ok=True)
+    base_ckpt = os.path.join(base_dir, "ckpt")
+    rc, _ = run_leg(leg_config(base_ckpt, pp=2), "config.json", base_dir)
+    if rc != 0:
+        return fail(f"baseline run (pp=2 mpmd) exited {rc}")
+    base_meta = _final_meta(base_ckpt)
+
+    fault_dir = os.path.join(workdir, "fault")
+    os.makedirs(fault_dir, exist_ok=True)
+    ckpt_dir = os.path.join(fault_dir, "ckpt")
+
+    # Leg 1: pp=2 MPMD, killed at step-3 begin; the sync save @2 durable.
+    rc, _ = run_leg(leg_config(ckpt_dir, pp=2,
+                               chaos_spec=f"kill@{STEPS // 2}"),
+                    "config_pp2.json", fault_dir)
+    if rc != -signal.SIGKILL:
+        return fail(f"leg 1 (pp=2) exited {rc}, expected "
+                    f"{-signal.SIGKILL} (SIGKILL)")
+
+    # Offline re-stamp: the store becomes a pp=1 checkpoint. Pure-pp, so
+    # the batch plan is untouched; the tool verifies the padded layer
+    # stacks match before mutating anything.
+    resize_log = os.path.join(fault_dir, "resize.log")
+    with open(resize_log, "ab") as log:
+        rc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "elastic_resize.py"),
+             ckpt_dir, "--pp", "1"],
+            stdout=log, stderr=subprocess.STDOUT, timeout=120).returncode
+    if rc != 0:
+        return fail(f"tools/elastic_resize.py --pp 1 exited {rc} "
+                    f"(see {resize_log})")
+
+    # Leg 2: pp=1 (SPMD — the executor fence requires pp>=2 for MPMD),
+    # elastic OFF: the re-stamped store needs no special config. Killed
+    # at step-5 begin; sync save @4 durable.
+    rc, _ = run_leg(leg_config(ckpt_dir, pp=1,
+                               chaos_spec=f"kill@{STEPS - 1}"),
+                    "config_pp1.json", fault_dir)
+    if rc != -signal.SIGKILL:
+        return fail(f"leg 2 (pp=1) exited {rc}, expected "
+                    f"{-signal.SIGKILL} (SIGKILL)")
+
+    # Leg 3: pp=2 MPMD with checkpoint.elastic — the runtime elastic path
+    # restores the pp=1-stamped step 4 into a pp=2 mesh; stage programs
+    # and the schedule table rebuild from config at startup.
+    rc, cfg3_path = run_leg(leg_config(ckpt_dir, pp=2, elastic=True),
+                            "config_pp2_elastic.json", fault_dir)
+    if rc != 0:
+        return fail(f"leg 3 (pp=2, elastic) exited {rc}, expected 0")
+
+    with open(os.path.join(fault_dir, "run.log")) as f:
+        log_text = f.read()
+    if verbose:
+        print(log_text)
+    if not re.search(r"elastic resize:", log_text):
+        return fail("marker /elastic resize:/ absent from the leg-3 log")
+
+    meta = _final_meta(ckpt_dir)
+    for key in ("step", "trained_tokens"):
+        if meta[key] != base_meta[key]:
+            return fail(f"final {key} {meta[key]} != fault-free baseline "
+                        f"{base_meta[key]}")
+
+    # Loss-trajectory parity: identical global batch and data order; the
+    # only legitimate pp=2-MPMD / pp=1-SPMD difference is fp32 reduction
+    # order (the parity bar test_mpmd pins much tighter per-executor).
+    def step_losses(jsonl_path: str) -> dict:
+        losses = {}
+        with open(jsonl_path) as f:
+            for line in f:
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn final line of a killed leg
+                if ev.get("kind") == "step" and "loss" in ev:
+                    losses[ev["step"]] = ev["loss"]  # last wins (replay)
+        return losses
+
+    base_losses = step_losses(os.path.join(base_ckpt, "telemetry.jsonl"))
+    fault_losses = step_losses(os.path.join(ckpt_dir, "telemetry.jsonl"))
+    if set(fault_losses) != set(base_losses):
+        return fail(f"step sets differ: fault {sorted(fault_losses)} vs "
+                    f"baseline {sorted(base_losses)}")
+    steps = sorted(base_losses)
+    bl = np.array([base_losses[s] for s in steps])
+    fl = np.array([fault_losses[s] for s in steps])
+    if not np.allclose(fl, bl, rtol=1e-3, atol=1e-4):
+        return fail(f"loss trajectory diverged from baseline: "
+                    f"{list(zip(steps, fl.tolist(), bl.tolist()))}")
+
+    # The resize must be booked, not just survived.
+    import telemetry_report
+
+    summary = telemetry_report.summarize(telemetry_report.load_events(
+        os.path.join(ckpt_dir, "telemetry.jsonl")))
+    if summary["categories"].get("resize", 0.0) <= 0.0:
+        return fail(f"no `resize` seconds in the goodput categories "
+                    f"({summary['categories']})")
+    if not summary.get("resize", {}).get("events"):
+        return fail("no elastic_resize event in the telemetry stream")
+
+    # Compile-once pin on the REBUILT stages: re-prove leg 3's config
+    # (the post-resize pp=2 MPMD layout) in a fresh process — every stage
+    # program must compile exactly once. 2 stages x fwd/bwd = 4 programs.
+    prover = ("import json, sys\n"
+              "from picotron_tpu.config import load_config\n"
+              "from picotron_tpu.analysis.variants import "
+              "prove_mpmd_stages\n"
+              "rep = prove_mpmd_stages(load_config(sys.argv[1]))\n"
+              "print('PROVE ' + json.dumps(rep.info['variants']))\n"
+              "sys.exit(0 if rep.ok() else 1)\n")
+    env = dict(os.environ)
+    for k in ("PICOTRON_COORDINATOR", "PICOTRON_NUM_PROCESSES",
+              "PICOTRON_PROCESS_ID"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    res = subprocess.run([sys.executable, "-c", prover, cfg3_path],
+                         capture_output=True, text=True, timeout=600,
+                         env=env)
+    lines = [ln for ln in res.stdout.splitlines()
+             if ln.startswith("PROVE ")]
+    if res.returncode != 0 or not lines:
+        return fail(f"post-resize stage prover exited {res.returncode}: "
+                    f"{res.stdout[-500:]}{res.stderr[-500:]}")
+    variants = json.loads(lines[-1][len("PROVE "):])
+    if not variants.get("proven") or variants.get("programs") != 4:
+        return fail(f"post-resize stages not proven compile-once: "
+                    f"{variants}")
+
+    print(f"[chaos-cli] pp_resize: OK — pp 2->1 (offline re-stamp) ->2 "
+          f"(runtime elastic, MPMD rebuild), final step {meta['step']} / "
+          f"{meta['trained_tokens']} tokens and loss trajectory match "
+          f"baseline; resize booked "
+          f"{summary['categories']['resize']:.3f}s; "
+          f"{variants['programs']} rebuilt stage programs proven "
+          f"compile-once")
+    return True
+
+
+def run_mpmd_sigterm(workdir: str, verbose: bool = False) -> bool:
+    """Mid-schedule fault hardening on the MPMD executor — two legs.
+
+    SIGTERM leg: `sigterm@3#2` lands the signal INSIDE the schedule walk
+    at a named (stage, tick, op) of step 3 — the hardest place to die,
+    with boundary buffers live and gradients half-accumulated. The
+    record-only preemption handler means the walk drains to the step
+    boundary, the emergency checkpoint persists a CLEAN step-3 state,
+    exit 75, and the supervised restart resumes with ZERO replayed steps
+    (telemetry stream is the witness).
+
+    Hang leg: `hang@4~120#1` wedges the walk at tick 1 of step 4 for far
+    longer than the watchdog timeout. The per-op heartbeat means the
+    watchdog names the live (stage, tick, op) in its report — not a bare
+    stack dump — then exits 77 for the supervisor; the restart resumes
+    from the last periodic save (steps ARE replayed here: the hang, by
+    design, persists nothing) and finishes at the baseline's step."""
+    fail = lambda msg: (print(f"[chaos-cli] mpmd_sigterm: FAIL — {msg}"),  # noqa: E731
+                        False)[1]
+
+    def leg_config(ckpt_dir: str, chaos_spec: str,
+                   overrides: dict) -> dict:
+        cfg = scenario_config(os.path.dirname(ckpt_dir), chaos_spec,
+                              {"checkpoint": {"async_save": False},
+                               **overrides})
+        cfg["distributed"].update(dp_size=1, tp_size=1, pp_size=2)
+        cfg["training"]["micro_batch_size"] = 2
+        cfg["training"]["gradient_accumulation_steps"] = 2
+        cfg["pipeline"] = {"executor": "mpmd"}
+        cfg["checkpoint"]["save_dir"] = ckpt_dir
+        return cfg
+
+    def run_leg(cfg: dict, leg_dir: str, extra_env: dict) -> int:
+        cfg_path = os.path.join(leg_dir, "config.json")
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+        return _run_trainer(cfg_path, os.path.join(leg_dir, "run.log"),
+                            extra_env)
+
+    # Fault-free pp=2 MPMD baseline.
+    base_dir = os.path.join(workdir, "baseline")
+    os.makedirs(base_dir, exist_ok=True)
+    base_ckpt = os.path.join(base_dir, "ckpt")
+    rc = run_leg(leg_config(base_ckpt, "", {}), base_dir, {})
+    if rc != 0:
+        return fail(f"baseline run (pp=2 mpmd) exited {rc}")
+    base_meta = _final_meta(base_ckpt)
+
+    # ---- SIGTERM mid-schedule ------------------------------------------
+    st_dir = os.path.join(workdir, "sigterm")
+    os.makedirs(st_dir, exist_ok=True)
+    st_ckpt = os.path.join(st_dir, "ckpt")
+    st_cfg = leg_config(st_ckpt, f"sigterm@{STEPS // 2}#2", {})
+    rc = run_leg(st_cfg, st_dir, {})
+    if rc != EXIT_PREEMPTED:
+        return fail(f"sigterm leg exited {rc}, expected {EXIT_PREEMPTED}")
+    # Restart with injection disabled — the resubmission does not re-live
+    # the preemption.
+    rc = run_leg(st_cfg, st_dir, {"PICOTRON_CHAOS": ""})
+    if rc != 0:
+        return fail(f"sigterm-leg restart exited {rc}, expected 0")
+
+    with open(os.path.join(st_dir, "run.log")) as f:
+        st_log = f.read()
+    if verbose:
+        print(st_log)
+    # The fault must really have landed mid-schedule, at the named tick.
+    if not re.search(r"firing sigterm at schedule_tick step "
+                     rf"{STEPS // 2} \(stage=\d+ tick=2 op=\w+", st_log):
+        return fail("no mid-schedule sigterm firing (schedule_tick with "
+                    "stage/tick/op) in the sigterm-leg log")
+    if not re.search(r"emergency checkpoint ->", st_log):
+        return fail("marker /emergency checkpoint ->/ absent — the drain "
+                    "to the step boundary did not persist durable state")
+
+    meta = _final_meta(st_ckpt)
+    for key in ("step", "trained_tokens"):
+        if meta[key] != base_meta[key]:
+            return fail(f"sigterm leg final {key} {meta[key]} != baseline "
+                        f"{base_meta[key]}")
+
+    # Lossless resume: the emergency checkpoint carried the full step-3
+    # state, so NO step number appears twice in the telemetry stream.
+    import telemetry_report
+
+    summary = telemetry_report.summarize(telemetry_report.load_events(
+        os.path.join(st_ckpt, "telemetry.jsonl")))
+    st = summary.get("steps") or {}
+    if st.get("count") != STEPS or st.get("max") != STEPS:
+        return fail(f"sigterm leg trained steps {st}, expected "
+                    f"count=max={STEPS}")
+    if st.get("replayed"):
+        return fail(f"sigterm leg replayed {st['replayed']} step(s) — the "
+                    f"mid-schedule preemption was supposed to drain to "
+                    f"the boundary and lose nothing")
+
+    # ---- forced hang mid-schedule --------------------------------------
+    hg_dir = os.path.join(workdir, "hang")
+    os.makedirs(hg_dir, exist_ok=True)
+    hg_ckpt = os.path.join(hg_dir, "ckpt")
+    hg_cfg = leg_config(
+        hg_ckpt, f"hang@{STEPS - 2}~120#1",
+        {"resilience": {"watchdog_timeout": 5.0}})
+    rc = run_leg(hg_cfg, hg_dir, {})
+    if rc != EXIT_WATCHDOG:
+        return fail(f"hang leg exited {rc}, expected {EXIT_WATCHDOG}")
+    rc = run_leg(hg_cfg, hg_dir, {"PICOTRON_CHAOS": ""})
+    if rc != 0:
+        return fail(f"hang-leg restart exited {rc}, expected 0")
+
+    with open(os.path.join(hg_dir, "run.log")) as f:
+        hg_log = f.read()
+    if verbose:
+        print(hg_log)
+    # The watchdog report must NAME the wedged op, not just dump stacks.
+    m = re.search(r"\[watchdog\] no progress .* last "
+                  r"phase='pp_schedule stage=\d+ tick=\d+ op=\w+ mb=\d+'",
+                  hg_log)
+    if not m:
+        return fail("watchdog report does not name the live "
+                    "(stage, tick, op) — /pp_schedule stage=/ phase "
+                    "absent from the hang-leg log")
+    meta = _final_meta(hg_ckpt)
+    for key in ("step", "trained_tokens"):
+        if meta[key] != base_meta[key]:
+            return fail(f"hang leg final {key} {meta[key]} != baseline "
+                        f"{base_meta[key]}")
+
+    print(f"[chaos-cli] mpmd_sigterm: OK — mid-schedule SIGTERM drained "
+          f"to the step boundary (exit {EXIT_PREEMPTED}, 0 replayed "
+          f"steps) and mid-schedule hang was watchdog-named "
+          f"({m.group(0).split('last ')[-1]}); both legs finished at "
+          f"baseline step {base_meta['step']}")
+    return True
+
+
 def _doctor_flags_exactly(save_dir: str, corrupt_step: int):
     """tools/ckpt_doctor.py over the faulted store must flag exactly the
     injected-corrupt step and pass the rest (the fsck half of the
@@ -438,6 +778,17 @@ CUSTOM_SCENARIOS: dict[str, tuple[Callable, str]] = {
                   "dp=1 offline, SIGKILL again, finish at dp=4 via "
                   "checkpoint.elastic; loss-trajectory parity vs the "
                   "dp=2 baseline, resize seconds booked"),
+    "pp_resize": (run_pp_resize,
+                  "elastic pipeline resize: SIGKILL a pp=2 MPMD run, "
+                  "re-stamp to pp=1 offline (--pp), SIGKILL again, "
+                  "finish at pp=2 via checkpoint.elastic; loss parity "
+                  "vs the pp=2 baseline, resize booked, rebuilt stage "
+                  "programs proven compile-once"),
+    "mpmd_sigterm": (run_mpmd_sigterm,
+                     "mid-schedule MPMD faults: SIGTERM at a named "
+                     "(stage, tick, op) drains to the step boundary "
+                     "(exit 75, zero replayed steps on resume); forced "
+                     "hang is watchdog-reported naming the live op"),
 }
 
 

@@ -26,6 +26,12 @@ Usage:
 GC applies the same retention policy the trainer's in-loop GC uses
 (picotron_tpu/ckpt_integrity.retention_plan) and the same protection: the
 last verified step survives regardless of --keep-last.
+
+The topology column is the routing surface for elastic re-stamps: a step
+rewritten by `tools/elastic_resize.py` (dp and/or pp) reports its NEW
+topology here — the store simply is that shape afterwards — so "which pp
+does this checkpoint restore at" is answered by this table, not by the
+config that originally trained it.
 """
 
 from __future__ import annotations

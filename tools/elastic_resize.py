@@ -1,7 +1,8 @@
 #!/usr/bin/env python
-"""Offline elastic re-stamp: adapt a verified checkpoint to a new dp.
+"""Offline elastic re-stamp: adapt a verified checkpoint to a new dp/pp.
 
-`python tools/elastic_resize.py CKPT_DIR --dp M [--step N] [--dry-run]`
+`python tools/elastic_resize.py CKPT_DIR [--dp M] [--pp K] [--step N]
+ [--dry-run]`  (at least one of --dp / --pp)
 
 The restore path (picotron_tpu/checkpoint.py) refuses to resume a
 checkpoint into a mesh whose topology differs from the one it was saved
@@ -9,11 +10,20 @@ under — unless `checkpoint.elastic` is on, or the checkpoint has been
 re-stamped by this tool. Re-stamping rewrites the step's meta.json for
 the new layout (dp_size, plus micro_batch_size/gradient_accumulation_
 steps re-factored at CONSTANT global batch — the token-exact cursor /
-loss-parity invariant) and re-commits the manifest with the new source
-topology, so the resumed run needs no special config: the checkpoint
-simply IS a dp=M checkpoint afterwards. The Orbax array data is not
-touched — global shapes are layout-independent and Orbax reshards onto
-whatever mesh restores them.
+loss-parity invariant; and/or pp_size) and re-commits the manifest with
+the new source topology, so the resumed run needs no special config: the
+checkpoint simply IS a dp=M (pp=K) checkpoint afterwards. The Orbax
+array data is not touched — global shapes are layout-independent and
+Orbax reshards onto whatever mesh restores them.
+
+A pp re-stamp is possible because checkpoints store the PP-PADDED global
+layer stack (models/llama.pp_layer_placement pads to pp * ceil(L/pp)):
+every pp whose split is even stores the SAME stack, so changing pp_size
+is pure metadata. The tool verifies the slot layouts match BEFORE
+touching anything; an uneven split (saved or target) bakes its pp into
+the padded shape and is refused with the slot mismatch named. pp does
+not enter global_batch_size (= mbs x ga x dp x ep), so a pure-pp
+re-stamp leaves the batch plan untouched.
 
 Safety: the step is deep-verified against its commit manifest BEFORE
 anything is rewritten. Re-stamping rebuilds the manifest from the
@@ -53,18 +63,26 @@ def list_steps(save_dir: str) -> list[int]:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="re-stamp a checkpoint step for a new dp size "
-                    "(constant global batch)")
+        description="re-stamp a checkpoint step for a new dp and/or pp "
+                    "size (constant global batch; even pp splits only)")
     ap.add_argument("save_dir", help="checkpoint directory (the trainer's "
                     "checkpoint.save_dir, containing step_XXXXXXXX dirs)")
-    ap.add_argument("--dp", type=int, required=True,
+    ap.add_argument("--dp", type=int, default=None,
                     help="target data-parallel size")
+    ap.add_argument("--pp", type=int, default=None,
+                    help="target pipeline-parallel size (the saved and "
+                         "target padded layer stacks must match — even "
+                         "splits only)")
     ap.add_argument("--step", type=int, default=None,
                     help="step to re-stamp (default: newest step that "
                          "passes verification)")
     ap.add_argument("--dry-run", action="store_true",
                     help="print the plan without touching the store")
     args = ap.parse_args(argv)
+    if args.dp is None and args.pp is None:
+        ap.error("pick a target topology: --dp M and/or --pp K")
+    if args.pp is not None and args.pp < 1:
+        ap.error(f"--pp must be >= 1, got {args.pp}")
 
     steps = list_steps(args.save_dir)
     if not steps:
@@ -109,17 +127,47 @@ def main(argv=None) -> int:
         return 1
 
     saved = elastic.saved_topology(step_dir) or {}
+    dp_new = args.dp if args.dp is not None else int(dist["dp_size"])
     try:
+        # identity plan when --dp is absent: a pure-pp re-stamp leaves
+        # the batch plan untouched (pp does not enter the global batch)
         plan = elastic.plan_resize(
             micro_batch_size=int(tr["micro_batch_size"]),
             gradient_accumulation_steps=int(
                 tr["gradient_accumulation_steps"]),
             dp_size=int(dist["dp_size"]),
-            dp_new=args.dp,
+            dp_new=dp_new,
             ep_size=int(dist.get("ep_size", 1)))
     except ValueError as e:
         print(f"cannot resize step {step}: {e}", file=sys.stderr)
         return 1
+
+    pp_old = int(saved.get("pp", dist.get("pp_size", 1)))
+    pp_new = args.pp if args.pp is not None else pp_old
+    if pp_new != pp_old:
+        # The slot-layout gate, BEFORE anything is rewritten: only a pp
+        # whose padded global layer stack matches the saved one (even
+        # splits) can consume the stored arrays. Uneven splits bake their
+        # pp into the padded shape — refuse with the mismatch named.
+        from picotron_tpu.models.llama import pp_layer_placement
+
+        layers = (cfg.get("model") or {}).get("num_hidden_layers")
+        if not layers:
+            print(f"step {step}'s meta.json records no "
+                  f"model.num_hidden_layers; cannot verify the pp "
+                  f"slot layout", file=sys.stderr)
+            return 1
+        src_padded, src_slots = pp_layer_placement(int(layers), pp_old)
+        dst_padded, dst_slots = pp_layer_placement(int(layers), pp_new)
+        if src_padded != dst_padded or list(src_slots) != list(dst_slots):
+            print(f"cannot re-stamp step {step} to pp={pp_new}: the saved "
+                  f"padded layer stack ({src_padded} slots at pp={pp_old}) "
+                  f"and the target's ({dst_padded} slots at pp={pp_new}) "
+                  f"place the {layers} real layers in different slots — "
+                  f"only even splits share a stack; pick a pp that "
+                  f"divides the padded layer count evenly",
+                  file=sys.stderr)
+            return 1
 
     dl_state = meta.get("dataloader")
     if dl_state:
@@ -132,6 +180,7 @@ def main(argv=None) -> int:
     new_topo = {ax: int(saved.get(ax, dist.get(f"{ax}_size", 1)))
                 for ax in elastic.TOPOLOGY_AXES}
     new_topo["dp"] = plan.dp_new
+    new_topo["pp"] = pp_new
     new_topo["world_size"] = 1
     for ax in elastic.TOPOLOGY_AXES:
         new_topo["world_size"] *= new_topo[ax]
@@ -144,6 +193,10 @@ def main(argv=None) -> int:
           f"-> mbs {plan.micro_batch_size} x ga "
           f"{plan.gradient_accumulation_steps} x dp {plan.dp_new} "
           f"(global batch {plan.global_batch_size}, unchanged)")
+    if pp_new != pp_old:
+        print(f"  pipeline  pp {pp_old} -> {pp_new} (same padded layer "
+              f"stack — metadata only; stage programs rebuild from "
+              f"config at startup)")
     if dl_state:
         print(f"  cursor    epoch {dl_state['epoch']}, sample "
               f"{dl_state['cursor']} (token-exact carry)")
@@ -152,6 +205,7 @@ def main(argv=None) -> int:
         return 0
 
     meta["config"]["distributed"]["dp_size"] = plan.dp_new
+    meta["config"]["distributed"]["pp_size"] = pp_new
     meta["config"]["training"]["micro_batch_size"] = plan.micro_batch_size
     meta["config"]["training"]["gradient_accumulation_steps"] = \
         plan.gradient_accumulation_steps
@@ -175,7 +229,8 @@ def main(argv=None) -> int:
         print(f"  manifest  re-committed, step re-verified")
     else:
         print(f"  manifest  none (legacy step) — meta.json rewritten only")
-    print(f"resume with distributed.dp_size={plan.dp_new} "
+    pp_hint = f" distributed.pp_size={pp_new}" if pp_new != pp_old else ""
+    print(f"resume with distributed.dp_size={plan.dp_new}{pp_hint} "
           f"training.micro_batch_size={plan.micro_batch_size} "
           f"training.gradient_accumulation_steps="
           f"{plan.gradient_accumulation_steps} (checkpoint.elastic not "
