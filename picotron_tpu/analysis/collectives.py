@@ -284,6 +284,84 @@ def audit_collectives(cfg, *, text: str = None, state=None,
                     f"SP f/g pair present over tp ({len(sp_ag)} "
                     f"all-gather, {len(sp_rs)} reduce-scatter ops of "
                     f"group size {d.tp_size})")
+    # deferred activation sync (parallel/tp_strategies.py): the
+    # row-parallel exit psum is rescheduled as a reduce-scatter at the
+    # block exit whose gather half is hoisted into the NEXT block's entry
+    # — the signature is the same AG/RS pair over tp as Megatron-SP, but
+    # it must be present even WITHOUT sequence_parallel. One op of each
+    # kind per block boundary in the program text (run_layers rolls the
+    # layer loop into a lax.scan, so the per-layer count is structural:
+    # the scan body lowers each boundary collective once).
+    if d.tp_sync == "deferred" and d.tp_size > 1:
+        df_rs = [op for op in eff if op.kind == "reduce_scatter"
+                 and op.group_size == d.tp_size]
+        df_ag = [op for op in eff if op.kind == "all_gather"
+                 and op.group_size == d.tp_size]
+        if not df_rs:
+            rep.add(CHECK, ERROR, "reduce_scatter",
+                    f"tp_sync=deferred with tp_size={d.tp_size} but no "
+                    f"reduce-scatter over tp: the deferred schedule's "
+                    f"block-exit RS is missing — partial row-parallel "
+                    f"outputs are never reduced across tp shards")
+        if not df_ag:
+            rep.add(CHECK, ERROR, "all_gather",
+                    f"tp_sync=deferred with tp_size={d.tp_size} but no "
+                    f"all-gather over tp: the gather half hoisted into "
+                    f"the next block's entry is missing — the seq-sharded "
+                    f"residual stream never re-assembles the full "
+                    f"sequence")
+        if df_rs and df_ag:
+            rep.add(CHECK, INFO, "deferred_pair",
+                    f"deferred-sync RS/AG pair present over tp "
+                    f"({len(df_ag)} all-gather, {len(df_rs)} "
+                    f"reduce-scatter ops of group size {d.tp_size})")
+
+    # non-megatron TP strategies (parallel/tp_strategies.py)
+    if d.tp_size > 1 and d.tp_strategy != "megatron":
+        from picotron_tpu.config import (
+            resolved_tp_mesh, resolved_tp_strategy,
+        )
+
+        strat = resolved_tp_strategy(cfg)
+        if "2d" in strat.values():
+            # the 2d schedule's signature: subgroup collectives — an
+            # activation/weight all-gather whose group spans exactly the
+            # INNER tp_y factor and a partial-sum all_reduce over the
+            # OUTER tp_x factor. (A full-tp all_reduce still legitimately
+            # appears for the vocab-parallel CE merge, so only the
+            # positive subgroup presences are checkable here; the
+            # shardflow provenance rule owns implicit-widening detection.)
+            tp_x, tp_y = resolved_tp_mesh(cfg)
+            if tp_y > 1 and not any(
+                    op.kind == "all_gather" and op.group_size == tp_y
+                    for op in eff):
+                rep.add(CHECK, ERROR, "all_gather",
+                        f"2d tp strategy {tp_x}x{tp_y} but no all-gather "
+                        f"of group size {tp_y}: the inner-subgroup "
+                        f"activation/weight gather is missing")
+            if tp_x > 1 and tp_x != d.tp_size and not any(
+                    op.kind == "all_reduce" and op.group_size == tp_x
+                    for op in eff):
+                rep.add(CHECK, ERROR, "all_reduce",
+                        f"2d tp strategy {tp_x}x{tp_y} but no all-reduce "
+                        f"of group size {tp_x}: the row-matmul partial "
+                        f"sum over the outer subgroup is missing")
+        if "row" in (strat["qkv"], strat["up"]):
+            # row-first entry: a full-tp psum of the projections; its
+            # column-parallel exit re-assembles features via all-gather
+            if not any(op.kind == "all_reduce"
+                       and op.group_size == d.tp_size for op in eff):
+                rep.add(CHECK, ERROR, "all_reduce",
+                        f"row-first tp strategy but no all-reduce of "
+                        f"group size {d.tp_size}: the block-entry "
+                        f"projection psum is missing")
+            if not any(op.kind == "all_gather"
+                       and op.group_size == d.tp_size for op in eff):
+                rep.add(CHECK, ERROR, "all_gather",
+                        f"row-first tp strategy but no all-gather of "
+                        f"group size {d.tp_size}: the column-parallel "
+                        f"exit's feature gather is missing")
+
     if d.cp_size > 1:
         from picotron_tpu.config import resolved_cp_flavor, resolved_cp_mesh
 

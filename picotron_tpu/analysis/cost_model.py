@@ -45,7 +45,8 @@ from dataclasses import dataclass, replace
 from typing import Optional
 
 from picotron_tpu.config import (
-    Config, num_params, resolved_cp_flavor, resolved_cp_mesh,
+    Config, num_params, parse_tp_strategy, resolved_cp_flavor,
+    resolved_cp_mesh, resolved_tp_mesh,
 )
 from picotron_tpu.utils import flops_per_token
 
@@ -178,9 +179,9 @@ def split_cp_link(link: AxisLink, cp_x: int, cp_y: int,
     ring leg shrinks from cp-1 line hops to cp_x-1, while the a2a leg
     stays inside a short contiguous subgroup."""
     inner_kind = "ring" if cp_y >= gen.wrap_min else "line"
-    inner = AxisLink("cp", cp_y, inner_kind, link.bandwidth, link.stride)
+    inner = AxisLink(link.axis, cp_y, inner_kind, link.bandwidth, link.stride)
     outer_kind = link.kind if cp_x > 1 else "line"
-    outer = AxisLink("cp", cp_x, outer_kind,
+    outer = AxisLink(link.axis, cp_x, outer_kind,
                      link.bandwidth / max(cp_y, 1), link.stride * cp_y)
     return outer, inner
 
@@ -221,6 +222,12 @@ class Calibration:
     # ~0.2 ms. Analytic default awaiting --pp-tick-sweep calibration.
     host_dispatch_s: float = 2.0e-4
     expose_layer: float = 1.0   # in-layer tp/sp/cp/ep collectives serialize
+    # deferred tp_sync (parallel/tp_strategies.py): the reduce-scatter at a
+    # block's exit still serializes, but its gather half is hoisted to the
+    # NEXT block's entry where it overlaps that block's norm + qkv/gate
+    # matmul issue window — only this fraction of the all-gather stays
+    # exposed. Analytic default awaiting on-TPU validation (PERF.md r15).
+    expose_deferred: float = 0.55
     # step-FLOPs multiplier per remat policy (recompute overhead), relative
     # to "dots" whose overhead the efficiency fit absorbs
     remat_flops: tuple = (("full", 1.30), ("dots", 1.0),
@@ -516,6 +523,29 @@ class CostModel:
                      * (f_dense_tok / eff_d + f_attn_tok / c.eff_attn)
                      / (world * self.gen.peak_flops))
 
+        # Non-megatron TP strategies (parallel/tp_strategies.py). The 2d
+        # row-side matmuls (o/down) contract a tp_y-times larger slab —
+        # weight rows are gathered within the inner subgroup so the
+        # contraction replicates tp_y-fold across it. Fold the extra FLOPs
+        # into compute_s so the bubble and overlap terms see the true
+        # critical path; the comm terms below price the collectives.
+        tp_strat = None
+        tp_x = tp_y = 1
+        if d.tp_size > 1:
+            from picotron_tpu.config import resolved_tp_strategy
+
+            tp_strat = resolved_tp_strategy(cfg, generation=self.gen.name)
+            if "2d" in tp_strat.values():
+                tp_x, tp_y = resolved_tp_mesh(cfg)
+                extra_tok = 0.0
+                if tp_strat["o"] == "2d":
+                    extra_tok += 2.0 * h * h
+                if tp_strat["down"] == "2d":
+                    extra_tok += 2.0 * h * m.intermediate_size
+                compute_s += (tokens * mult * m.num_hidden_layers
+                              * extra_tok * (tp_y - 1)
+                              / (eff_d * world * self.gen.peak_flops))
+
         # Pipeline bubble — executor-dependent (parallel/mpmd.py):
         # - spmd: the lockstep scan runs n + 2(pp-1) ticks and EVERY tick
         #   costs a full traced unit on every device (PERF.md r4: idle
@@ -577,19 +607,72 @@ class CostModel:
             add("zero1_gather", "all_gather", ("dp",), 1,
                 act_bytes * n_grad_local, c.expose_grad)
 
-        # TP: 2 fwd + 2 bwd boundary collectives per layer per microbatch;
-        # Megatron-SP replaces each psum with an all-gather/reduce-scatter
-        # pair of the same volume
-        if d.tp_size > 1:
-            n_ops = 4 * layers_stage * ga
-            if d.sequence_parallel:
-                add("sp_gather", "all_gather", ("tp",), n_ops, v_act,
-                    c.expose_layer)
-                add("sp_scatter", "reduce_scatter", ("tp",), n_ops, v_act,
-                    c.expose_layer)
-            else:
-                add("tp_psum", "all_reduce", ("tp",), n_ops, v_act,
-                    c.expose_layer)
+        # TP: 2 fwd + 2 bwd boundary collectives per layer per microbatch
+        # on the megatron col/row pairing; Megatron-SP replaces each psum
+        # with an all-gather/reduce-scatter pair of the same volume, and
+        # tp_sync=deferred keeps the SP pair but hoists the gather into the
+        # next block's entry (only expose_deferred of it stays exposed).
+        # The row-first pairing moves the psum to the block ENTRY (over the
+        # full projection width — wider than hidden) and exits with a
+        # feature all-gather; the 2d pairing splits tp into tp_x x tp_y
+        # subgroups: an activation + weight-rows all-gather over the inner
+        # tp_y link and a psum shrunk to the outer tp_x link.
+        if d.tp_size > 1 and tp_strat is not None:
+            deferred = d.tp_sync == "deferred"
+            pair_kinds = (("attn", tp_strat["qkv"]), ("mlp", tp_strat["up"]))
+            n_pair = 2 * layers_stage * ga   # fwd + bwd, per pair per micro
+            n_boundary = sum(n_pair for _, k in pair_kinds if k == "col")
+            if n_boundary:
+                if deferred:
+                    add("tp_defer_gather", "all_gather", ("tp",),
+                        n_boundary, v_act, c.expose_deferred)
+                    add("tp_defer_scatter", "reduce_scatter", ("tp",),
+                        n_boundary, v_act, c.expose_layer)
+                elif d.sequence_parallel:
+                    add("sp_gather", "all_gather", ("tp",), n_boundary,
+                        v_act, c.expose_layer)
+                    add("sp_scatter", "reduce_scatter", ("tp",), n_boundary,
+                        v_act, c.expose_layer)
+                else:
+                    add("tp_psum", "all_reduce", ("tp",), n_boundary,
+                        v_act, c.expose_layer)
+            tok_mb = mbs * (s // d.cp_size)
+            p_bytes = _DTYPE_BYTES.get(m.dtype, 2)
+            attn_w = m.num_attention_heads * m.head_dim
+            proj = {"attn": attn_w + 2 * m.num_key_value_heads * m.head_dim,
+                    "mlp": 2 * m.intermediate_size}
+            gath = {"attn": proj["attn"], "mlp": m.intermediate_size}
+            wrows = {"attn": attn_w, "mlp": m.intermediate_size}
+            for pair, kind in pair_kinds:
+                if kind == "row":
+                    add(f"tp_row_psum_{pair}", "all_reduce", ("tp",),
+                        n_pair, tok_mb * proj[pair] * act_bytes,
+                        c.expose_layer)
+                    add(f"tp_row_gather_{pair}", "all_gather", ("tp",),
+                        n_pair, v_act, c.expose_layer)
+                elif kind == "2d" and "tp" in links:
+                    outer, inner = split_cp_link(links["tp"], tp_x, tp_y,
+                                                 self.gen)
+                    if tp_y > 1:
+                        v_g = tok_mb * gath[pair] // tp_x * act_bytes
+                        terms.append(CommTerm(
+                            f"tp2d_gather_{pair}", "all_gather", ("tp",),
+                            n_pair, v_g,
+                            self.collective_secs("all_gather", v_g, inner),
+                            c.expose_layer))
+                        v_w = wrows[pair] * h // tp_x * p_bytes
+                        terms.append(CommTerm(
+                            f"tp2d_wgather_{pair}", "all_gather", ("tp",),
+                            n_pair, v_w,
+                            self.collective_secs("all_gather", v_w, inner),
+                            c.expose_layer))
+                    if tp_x > 1:
+                        terms.append(CommTerm(
+                            f"tp2d_psum_{pair}", "all_reduce", ("tp",),
+                            n_pair, v_act,
+                            self.collective_secs("all_reduce", v_act,
+                                                 outer),
+                            c.expose_layer))
 
         # CP: ring (K/V shift chain fwd, K/V + dK/dV bwd), the Ulysses
         # seq<->head all_to_all pair each way, or the mesh flavor's 2D
@@ -656,6 +739,16 @@ def layout_label(cfg: Config) -> str:
                                     if d.cp_flavor == "mesh" else ""))
     if d.sequence_parallel:
         flags.append("sp")
+    if d.tp_size > 1 and d.tp_strategy != "megatron":
+        if d.tp_strategy == "2d":
+            tp_x, tp_y = resolved_tp_mesh(cfg)
+            flags.append(f"tp2d-{tp_x}x{tp_y}")
+        elif d.tp_strategy in ("row", "adaptive"):
+            flags.append("tp" + d.tp_strategy)
+        else:
+            flags.append("tpmix")
+    if d.tp_sync == "deferred":
+        flags.append("deferred")
     if d.zero1:
         flags.append("zero1")
     if t.optimizer_offload:
@@ -754,6 +847,116 @@ def cp_crossover(model: CostModel, base: Config,
         if row["winner"] == "mesh":
             return row["cp"]
     return None
+
+
+# ---------------------------------------------------------------------------
+# TP-strategy pricing + adaptive selection
+# ---------------------------------------------------------------------------
+
+
+def feasible_tp_meshes(cfg: Config, tp: Optional[int] = None) -> list:
+    """True-2D (tp_x, tp_y) factorizations of the tp degree — both factors
+    > 1 (degenerates ARE megatron: tp_y=1 has no inner gather and tp_x=1
+    no outer psum shrink) and tp_x dividing the q AND kv head counts (the
+    2d attention runs heads/tp_x, tp_y-replicated)."""
+    m = cfg.model
+    tp = tp or cfg.distributed.tp_size
+    return [(tp // y, y) for y in range(2, tp)
+            if tp % y == 0 and tp // y > 1
+            and m.num_attention_heads % (tp // y) == 0
+            and m.num_key_value_heads % (tp // y) == 0]
+
+
+def price_tp_strategy(model: CostModel, cfg: Config, strategy: str,
+                      sync: str = "sync", tp_mesh: str = "") -> StepCost:
+    """Price `cfg` with its TP strategy/sync knobs forced — the one-call
+    query behind `choose_tp_strategy` and the `--tp-strategy-table` CLI.
+    No validation is re-run: this is a pricing probe, so the caller owns
+    eligibility (the planner only probes eligible configs)."""
+    return model.predict(replace(cfg, distributed=replace(
+        cfg.distributed, tp_strategy=strategy, tp_sync=sync,
+        tp_mesh=tp_mesh)))
+
+
+def _pair_spec(attn_kind: str, mlp_kind: str) -> str:
+    """Explicit per-class spec string for a (attn-pair, mlp-pair) choice,
+    respecting the legal (entry, exit) pairings config.parse_tp_strategy
+    enforces: col pairs with row, row with col, 2d with 2d."""
+    exit_of = {"col": "row", "row": "col", "2d": "2d"}
+    return (f"qkv={attn_kind},o={exit_of[attn_kind]},"
+            f"up={mlp_kind},down={exit_of[mlp_kind]},head=col")
+
+
+def choose_tp_strategy(cfg: Config, generation: str = "v5e") -> dict:
+    """Resolve tp_strategy='adaptive': per-class argmin over the legal
+    pair partitionings, priced on `generation`'s ICI descriptor (the ATP
+    selection loop, arxiv 2301.08658, collapsed to the three partitionings
+    this runtime implements). Deterministic: candidates are enumerated in
+    a fixed order with a strict < comparison, so megatron (first) wins
+    ties — tp degrees where no alternative strictly helps keep the
+    reference layout. Pure arithmetic; resolves in microseconds."""
+    model = CostModel(generation)
+    d = cfg.distributed
+    tp_x, tp_y = resolved_tp_mesh(cfg)
+    kinds = ["col", "row"] + (["2d"] if tp_x > 1 and tp_y > 1 else [])
+    best_s, best_spec = None, _pair_spec("col", "col")
+    for ak in kinds:
+        for mk in kinds:
+            spec = _pair_spec(ak, mk)
+            cost = price_tp_strategy(model, cfg, spec, sync=d.tp_sync,
+                                     tp_mesh=d.tp_mesh)
+            if best_s is None or cost.total_s < best_s:
+                best_s, best_spec = cost.total_s, spec
+    return parse_tp_strategy(best_spec)
+
+
+def tp_strategy_table(model: CostModel, base: Config,
+                      tp_degrees=(2, 4, 8, 16)) -> list[dict]:
+    """Sweep tp degree for `base`'s model/batch on `model`'s generation
+    and report, per degree, each strategy x sync-mode's predicted step
+    time and exposed-comm time, the best 2d factorization, the adaptive
+    resolution, and the winner — the table
+    `tools/layout_planner.py --tp-strategy-table` prints. Degrees the
+    model cannot shard (head/kv/vocab divisibility) are skipped."""
+    m = base.model
+    rows = []
+    for tp in tp_degrees:
+        if (tp < 2 or m.num_attention_heads % tp
+                or m.num_key_value_heads % tp or m.vocab_size % tp):
+            continue
+        cfg = replace(base, distributed=replace(
+            base.distributed, tp_size=tp, tp_strategy="megatron",
+            tp_sync="sync", tp_mesh=""))
+        variants: dict[str, StepCost] = {
+            "megatron": model.predict(cfg),
+            "deferred": price_tp_strategy(model, cfg, "megatron",
+                                          sync="deferred"),
+            "row": price_tp_strategy(model, cfg, "row"),
+        }
+        row = {"tp": tp, "generation": model.gen.name}
+        best2d = None
+        for tp_mx, tp_my in feasible_tp_meshes(cfg, tp):
+            cost = price_tp_strategy(model, cfg, "2d",
+                                     tp_mesh=f"{tp_mx}x{tp_my}")
+            if best2d is None or cost.total_s < best2d[0].total_s:
+                best2d = (cost, f"{tp_mx}x{tp_my}")
+        if best2d is not None:
+            variants["2d"] = best2d[0]
+            row["mesh_factorization"] = best2d[1]
+        base_exposed = variants["megatron"].exposed_comm_s
+        for name, cost in variants.items():
+            row[f"{name}_ms"] = round(cost.total_s * 1e3, 3)
+            row[f"{name}_exposed_ms"] = round(cost.exposed_comm_s * 1e3, 3)
+            row[f"{name}_exposed_delta_ms"] = round(
+                (cost.exposed_comm_s - base_exposed) * 1e3, 3)
+        adaptive = choose_tp_strategy(replace(cfg, distributed=replace(
+            cfg.distributed, tp_strategy="adaptive")),
+            generation=model.gen.name)
+        row["adaptive"] = ",".join(
+            f"{k}={adaptive[k]}" for k in ("qkv", "o", "up", "down"))
+        row["winner"] = min(variants, key=lambda k: variants[k].total_s)
+        rows.append(row)
+    return rows
 
 
 # ---------------------------------------------------------------------------

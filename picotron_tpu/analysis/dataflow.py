@@ -275,10 +275,21 @@ def intended_rule(cfg, site) -> str:
     ax = set(site.axes)
     if not ax:
         return None
+    tp2d = False
+    if d.tp_size > 1 and d.tp_strategy not in ("megatron", ""):
+        from picotron_tpu.config import resolved_tp_strategy
+
+        tp2d = "2d" in resolved_tp_strategy(cfg).values()
     if site.kind == "all_reduce":
         if ax <= {"dp", "ep", "cp"}:
             return "data-axes grad/loss sync"
         if ax == {"tp"}:
+            # covers the megatron boundary psum, the vocab-parallel CE
+            # merge, the row-first block-entry projection psum, and —
+            # when the site carries an axis_index_groups subgroup — the
+            # 2d strategy's partial sum over the outer tp_x factor
+            if tp2d and site.group:
+                return "2d TP outer-subgroup psum"
             return "TP boundary psum"
         if ax == {"pp"} and d.pp_size > 1:
             # per-stage loss stats and pp-replicated params (embedding /
@@ -291,6 +302,16 @@ def intended_rule(cfg, site) -> str:
     if site.kind in ("all_gather", "reduce_scatter"):
         if ax == {"tp"} and d.sequence_parallel:
             return "Megatron-SP f/g pair"
+        if ax == {"tp"} and d.tp_sync == "deferred":
+            # the rescheduled row-parallel exit: RS at the block exit,
+            # gather hoisted to the next block's entry
+            return "deferred-sync RS/AG pair"
+        if ax == {"tp"} and tp2d and site.group:
+            return "2d TP inner-subgroup gather"
+        if ax == {"tp"} and d.tp_strategy not in ("megatron", ""):
+            # row-first column-parallel exit re-assembling features (and
+            # the AD transposes of the entry psum)
+            return "TP strategy feature gather"
         if ax == {"dp"} and d.zero1:
             return "ZeRO-1 shard round-trip"
         if ax == {"cp"} and mesh_cp and site.group:

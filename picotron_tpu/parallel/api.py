@@ -205,6 +205,13 @@ def make_parallel_ctx(cfg: Config) -> ParallelCtx:
             moe_aux_sync=lambda a: lax.pmean(a, "tp"),
         )
 
+    # Non-megatron TP strategies and deferred activation sync install their
+    # hook overrides on top (parallel/tp_strategies.py); {} on the plain
+    # megatron/SP sync paths, so those stay byte-identical.
+    from picotron_tpu.parallel.tp_strategies import tp_strategy_hooks
+
+    hooks.update(tp_strategy_hooks(cfg, ce=ce))
+
     # Uneven-PP padding: mask the aux statistics of pad slots from the
     # STATIC placement rule (pp_layer_placement puts each stage's real
     # layers in its leading slots; remainder to early stages) rather than

@@ -101,6 +101,18 @@ def _vary_like(x, ref):
     return _vary_over(x, set(compat.vma(ref)))
 
 
+def _o_exit(ctx: ParallelCtx, outf, w_o, dt):
+    """The o-projection + TP block exit, dispatched through the strategy
+    hook exactly as models/llama.py's _attention_block does — the single
+    definition both the forward scan and the backward segment VJPs close
+    over, so the fused engine emits whatever collectives the strategy
+    chose (megatron psum, SP/deferred reduce-scatter, 2d subgroup psum,
+    row-first feature gather)."""
+    if ctx.o_mm is not None:
+        return ctx.o_mm(outf, w_o)
+    return ctx.g(outf @ w_o.astype(dt))
+
+
 def _attn_paths(cfg: Config, ctx: ParallelCtx, cos, sin):
     """(attn_fwd, attn_bwd) closures for this config's attention schedule,
     mirroring parallel/api.py's dispatch exactly:
@@ -294,12 +306,12 @@ def fused_micro_grads(params, ids, tgt, g_acc, cfg: Config,
         params["embedding"])
 
     def fwd_body(x, lp):
-        h1 = rms_norm(x, lp["input_norm"], eps)
+        h1 = rms_norm(ctx.pre(x), lp["input_norm"], eps)
         hf = ctx.f(h1)
-        q, k, v = qkv_proj(hf, lp, hd)
+        q, k, v = (ctx.qkv_mm or qkv_proj)(hf, lp, hd)
         out, lse = attn_fwd(q, k, v)
         outf = flat(out)
-        a = x + ctx.g(outf @ lp["o"].astype(x.dtype))
+        a = x + _o_exit(ctx, outf, lp["o"], x.dtype)
         if moe:
             mo, aux = _moe_block(a, lp, m, ctx)
             y = a + mo
@@ -347,7 +359,7 @@ def fused_micro_grads(params, ids, tgt, g_acc, cfg: Config,
         # recompute set), derive the block's grads by segment VJP. For MoE
         # the routing recomputes deterministically and the aux-loss fold
         # (aux * count) rides the segment so balance/z grads flow.
-        a = x + ctx.g(outf @ lp["o"].astype(x.dtype))
+        a = x + _o_exit(ctx, outf, lp["o"], x.dtype)
 
         if moe:
             def seg_mlp(a_, *ws):
@@ -371,7 +383,7 @@ def fused_micro_grads(params, ids, tgt, g_acc, cfg: Config,
             da, d_post, *d_ws = vjp_b(dy)
 
         def seg_o(x_, outf_, wo):
-            return x_ + ctx.g(outf_ @ wo.astype(x_.dtype))
+            return x_ + _o_exit(ctx, outf_, wo, x_.dtype)
 
         _, vjp_o = jax.vjp(seg_o, x, outf, lp["o"])
         dx1, doutf, d_o = vjp_o(da)
@@ -382,9 +394,9 @@ def fused_micro_grads(params, ids, tgt, g_acc, cfg: Config,
             lpq = dict(lp)
             lpq.update(input_norm=w_in, q=wq, k=wk, v=wv,
                        **dict(zip(bias_keys, bs)))
-            h1_ = rms_norm(x_, w_in, eps)
+            h1_ = rms_norm(ctx.pre(x_), w_in, eps)
             hf_ = ctx.f(h1_)
-            q_, k_, v_ = qkv_proj(hf_, lpq, hd)
+            q_, k_, v_ = (ctx.qkv_mm or qkv_proj)(hf_, lpq, hd)
             return flat(q_), flat(k_), flat(v_)
 
         _, vjp_q = jax.vjp(seg_qkv, x, lp["input_norm"], lp["q"], lp["k"],

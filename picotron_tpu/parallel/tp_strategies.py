@@ -1,0 +1,300 @@
+"""Per-layer-class TP partitioning strategies + deferred activation sync.
+
+The fixed Megatron pattern (parallel/tp.py) pays one synchronous collective
+per block half on the critical path: the row-parallel exit psum (or the SP
+reduce-scatter/all-gather pair). Two relaxations, both selected per config
+and threaded through the ParallelCtx hooks so the AD and fused grad engines
+emit identical collectives:
+
+**Adaptive per-layer partitioning** (ATP, arxiv 2301.08658). Each layer
+class (attn-qkv / attn-o / mlp-up / mlp-down / head) carries a strategy in
+{col, row, 2d}; `distributed.tp_strategy` names a preset or an explicit
+per-class spec, and "adaptive" resolves the per-class argmin against the
+ICI cost model (analysis/cost_model.py price_tp_strategy). The weight
+STORAGE layout never changes — all strategies reuse the 1D megatron shards
+from parallel/sharding.py (column shards for qkv/gate/up, row shards for
+o/down, re-sharded per class for "row") and express the alternative
+partitionings as different collective schedules over those shards:
+
+- **col/row (megatron)**: the default f/g pair; no hooks installed, the
+  block code path is byte-identical to before this module existed.
+- **row-first**: qkv/up contract a per-rank SLICE of the replicated input
+  against input-sharded weights and psum the partial projections; o/down
+  are column-parallel, so the block exit becomes a feature all-gather —
+  V·(n-1)/n bytes instead of the psum's 2·V·(n-1)/n — at the price of
+  tp-replicated attention (every rank holds all heads). Honest about the
+  replication: the cost model prices it and row-first loses at today's
+  shapes; it exists as a searchable point, not a recommendation.
+- **2d**: tp factors into tp_x x tp_y (rank r = ix*tp_y + iy, iy-minor so
+  tp_y subgroups are contiguous = innermost ICI links). Column matmuls run
+  exactly as megatron (full contraction, 1/tp of the output features, no
+  collective) and an all-gather within the tp_y subgroup assembles the
+  1/tp_x feature block; attention runs with heads/tp_x (replicated tp_y
+  ways). Row matmuls all-gather the WEIGHT rows within the tp_y subgroup
+  and contract the full feature block, so the exit psum shrinks to the
+  tp_x subgroup — activation bytes over tp/tp_y ranks instead of tp, at
+  the price of tp_y-replicated row-matmul flops plus a small weight
+  gather. On a torus the subgroup psum also rides shorter rings.
+
+**Deferred activation sync** (partially-synchronized-activation TP, arxiv
+2506.19645). `distributed.tp_sync="deferred"` replaces the megatron exit
+psum with a reduce-scatter over the sequence whose gather half is hoisted
+into the NEXT block's entry (`ParallelCtx.pre`, applied to the block input
+before the norm): the residual stream stays seq-sharded [*, S/tp, H]
+between blocks and the entry all-gather's first consumer is the block's
+own norm+qkv chain, so XLA's latency-hiding scheduler can overlap it with
+the preceding block's tail compute instead of stalling on a synchronous
+psum. Numerics are exact (RMSNorm is per-token; same reduce tree as SP),
+pinned by fp32 parity twins against the sync path and the loss-pinned
+dryrun patterns (`sp-deferred`) in __graft_entry__.py, and the shardflow
+provenance rules (analysis/dataflow.py) prove no implicit reshard.
+
+Everything here runs inside shard_map with the 'tp' axis in scope; the
+subgroup collectives use `axis_index_groups` over the single named axis
+(the PR-13 mesh-attention submesh idiom — the submesh never becomes a mesh
+axis, so dp/cp/ep composition is untouched).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from jax import lax
+from jax.ad_checkpoint import checkpoint_name
+
+from picotron_tpu import compat
+from picotron_tpu.config import (
+    Config, resolved_tp_mesh, resolved_tp_strategy,
+)
+from picotron_tpu.parallel.tp import (
+    sp_gather_seq, sp_scatter_seq, vocab_parallel_embed,
+)
+
+
+def _identity(x):
+    return x
+
+
+def tp_subgroups(tp_x: int, tp_y: int):
+    """(ty_groups, tx_groups) over the single named 'tp' axis for the
+    iy-minor rank layout r = ix*tp_y + iy.
+
+    ty_groups: tp_x subgroups of tp_y contiguous ranks (fixed ix) — the
+    feature/weight all-gathers run within these, landing on the innermost
+    ICI links. tx_groups: tp_y subgroups of tp_x strided ranks (fixed iy)
+    — the shrunken exit psum runs within these.
+    """
+    ty_groups = [[ix * tp_y + iy for iy in range(tp_y)]
+                 for ix in range(tp_x)]
+    tx_groups = [[ix * tp_y + iy for ix in range(tp_x)]
+                 for iy in range(tp_y)]
+    return ty_groups, tx_groups
+
+
+def _varying(x, axis: str = "tp"):
+    """Type x as varying over `axis` (identity on values). Strategy exits
+    whose collectives run over subgroups (2d) or all-gathers (row) leave
+    the residual replicated in VALUE but the vma type differs per exit
+    kind; pinning every strategy exit (and the embedding entry) to the
+    varying type keeps the layer-scan carry type stable across mixed
+    per-class strategies."""
+    if axis in compat.vma(x):
+        return x
+    return compat.pcast(x, (axis,), to="varying")
+
+
+# ---------------------------------------------------------------------------
+# 2d hooks — tp = tp_x x tp_y over the 1D megatron shards
+# ---------------------------------------------------------------------------
+
+
+def qkv_mm_2d(h, lp, d: int, *, ty_groups, axis: str = "tp"):
+    """Column-parallel qkv (megatron compute, 1/tp of the features) + a
+    tp_y-subgroup all-gather assembling the 1/tp_x head block. Mirrors
+    qkv_proj's contract: [B,S,H] -> ([B,S,Hq/tp_x,D], kv..), flat
+    projections checkpoint-named "qkv_out" AFTER the gather (the gathered
+    flats are what attention consumes and the remat policies must save)."""
+    dt = h.dtype
+    b, s, _ = h.shape
+
+    def col_gather(w):
+        y = h @ w.astype(dt)
+        if len(ty_groups[0]) > 1:
+            y = lax.all_gather(y, axis, axis=-1, tiled=True,
+                               axis_index_groups=ty_groups)
+        return checkpoint_name(y, "qkv_out")
+
+    q, k, v = col_gather(lp["q"]), col_gather(lp["k"]), col_gather(lp["v"])
+    return (q.reshape(b, s, -1, d), k.reshape(b, s, -1, d),
+            v.reshape(b, s, -1, d))
+
+
+def o_mm_2d(outf, w, *, ty_groups, tx_groups, axis: str = "tp"):
+    """Row matmul over the tp_y-gathered weight rows + a tp_x-subgroup exit
+    psum. outf [B,S,q_out/tp_x] (the 2d attention output, flat); w is the
+    megatron row shard [q_out/tp, H] — its tp_y-subgroup gather is the
+    1/tp_x row block matching outf's features."""
+    wg = w.astype(outf.dtype)
+    if len(ty_groups[0]) > 1:
+        wg = lax.all_gather(wg, axis, axis=0, tiled=True,
+                            axis_index_groups=ty_groups)
+    part = checkpoint_name(outf @ wg, "attn_proj_out")
+    return _varying(lax.psum(part, axis, axis_index_groups=tx_groups), axis)
+
+
+def mlp_mm_2d(h, lp, cfg, *, ty_groups, tx_groups, axis: str = "tp"):
+    """The full 2d MLP after the entry norm: column gate/up (megatron
+    compute), the activation product gathered ONCE within the tp_y
+    subgroup (elementwise, so act(gate)*up commutes with the gather),
+    then the row down-projection against tp_y-gathered weight rows with a
+    tp_x-subgroup exit psum."""
+    from picotron_tpu.models.llama import mlp_act
+
+    dt = h.dtype
+    gate = checkpoint_name(h @ lp["gate"].astype(dt), "mlp_gate")
+    up = checkpoint_name(h @ lp["up"].astype(dt), "mlp_up")
+    inter = mlp_act(cfg)(gate) * up
+    wd = lp["down"].astype(dt)
+    if len(ty_groups[0]) > 1:
+        inter = lax.all_gather(inter, axis, axis=-1, tiled=True,
+                               axis_index_groups=ty_groups)
+        wd = lax.all_gather(wd, axis, axis=0, tiled=True,
+                            axis_index_groups=ty_groups)
+    return _varying(lax.psum(inter @ wd, axis, axis_index_groups=tx_groups),
+                    axis)
+
+
+# ---------------------------------------------------------------------------
+# row-first hooks — input-sharded entry, column-parallel exit
+# ---------------------------------------------------------------------------
+
+
+def _slice_features(x, n: int, axis: str = "tp"):
+    """This rank's 1/n slab of the replicated feature dim (the row-parallel
+    contraction input). The slice's transpose (dynamic-update into zeros)
+    plus the varying->invariant boundary psum reassembles the full-feature
+    cotangent, exactly megatron's f-backward."""
+    chunk = x.shape[-1] // n
+    idx = lax.axis_index(axis)
+    return lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=x.ndim - 1)
+
+
+def qkv_mm_row(h, lp, d: int, *, tp: int, axis: str = "tp"):
+    """Row-parallel qkv: each rank contracts its feature slab against its
+    input-sharded weight [H/tp, q_out] and the psum assembles the FULL
+    projections — attention then runs tp-replicated (all heads on every
+    rank; the cost model charges the replication)."""
+    dt = h.dtype
+    b, s, _ = h.shape
+    hs = _slice_features(h, tp, axis)
+
+    def row_psum(w):
+        y = lax.psum(hs @ w.astype(dt), axis)
+        return checkpoint_name(y, "qkv_out")
+
+    q, k, v = row_psum(lp["q"]), row_psum(lp["k"]), row_psum(lp["v"])
+    return (q.reshape(b, s, -1, d), k.reshape(b, s, -1, d),
+            v.reshape(b, s, -1, d))
+
+
+def o_mm_row(outf, w, *, axis: str = "tp"):
+    """Column-parallel o: [B,S,q_out] @ [q_out, H/tp] then a feature
+    all-gather — half the exit bytes of the psum it replaces."""
+    part = checkpoint_name(outf @ w.astype(outf.dtype), "attn_proj_out")
+    return _varying(lax.all_gather(part, axis, axis=-1, tiled=True), axis)
+
+
+def mlp_mm_row(h, lp, cfg, *, tp: int, axis: str = "tp"):
+    """Row-parallel gate/up (two entry psums) + column-parallel down with
+    the feature all-gather exit."""
+    from picotron_tpu.models.llama import mlp_act
+
+    dt = h.dtype
+    hs = _slice_features(h, tp, axis)
+    gate = checkpoint_name(lax.psum(hs @ lp["gate"].astype(dt), axis),
+                           "mlp_gate")
+    up = checkpoint_name(lax.psum(hs @ lp["up"].astype(dt), axis), "mlp_up")
+    part = (mlp_act(cfg)(gate) * up) @ lp["down"].astype(dt)
+    return _varying(lax.all_gather(part, axis, axis=-1, tiled=True), axis)
+
+
+# ---------------------------------------------------------------------------
+# hook assembly
+# ---------------------------------------------------------------------------
+
+
+def uses_strategy_hooks(cfg: Config) -> bool:
+    """True when this config installs any non-megatron hook (strategy or
+    deferred sync) — the audit/pricing dispatch key."""
+    d = cfg.distributed
+    return d.tp_size > 1 and (d.tp_strategy != "megatron"
+                              or d.tp_sync == "deferred")
+
+
+def tp_strategy_hooks(cfg: Config, ce=None) -> dict:
+    """ParallelCtx hook overrides for this config's TP strategy and sync
+    mode; {} when the config runs plain megatron (sync), so the default
+    (and SP) paths are untouched.
+
+    `ce` is the vocab-parallel head-CE callable `(x, head, tgt) ->
+    (nll_sum, count)` the deferred head hook composes with its gather
+    (make_parallel_ctx passes its chunk-size-bound partial)."""
+    d = cfg.distributed
+    tp = d.tp_size
+    if not uses_strategy_hooks(cfg):
+        return {}
+
+    if d.tp_sync == "deferred":
+        # Megatron collectives, rescheduled: exit reduce-scatter over the
+        # sequence, gather hoisted to the next block's entry (pre). The
+        # residual stays seq-sharded; the norm runs AFTER the gather
+        # (full-sequence, per-token — numerics identical to sync), so the
+        # entry all-gather heads the block's compute chain where XLA can
+        # overlap it. Composes with sequence_parallel (the "sp-deferred"
+        # pattern): same collectives, the SP f/g placement replaced by
+        # the pre/g placement.
+        hooks = dict(
+            pre=sp_gather_seq,
+            f=_identity,
+            g=sp_scatter_seq,
+            embed_lookup=partial(vocab_parallel_embed, axis="tp",
+                                 scatter_seq=True),
+            head_in=sp_gather_seq,
+            seq_shard=tp,
+            # the local/merge CE split cannot host the seq gather inside a
+            # divergent branch (same constraint as SP); pp is gated off so
+            # nothing consumes it, but keep the fields honest
+            head_ce_local=None,
+            head_ce_merge=None,
+        )
+        if ce is not None:
+            hooks["head_ce"] = lambda x, head, tgt: ce(
+                sp_gather_seq(x), head, tgt)
+        return hooks
+
+    spec = resolved_tp_strategy(cfg)
+    hooks = {}
+    if spec["qkv"] == "2d":
+        tp_x, tp_y = resolved_tp_mesh(cfg)
+        ty_g, tx_g = tp_subgroups(tp_x, tp_y)
+        hooks["qkv_mm"] = partial(qkv_mm_2d, ty_groups=ty_g)
+        hooks["o_mm"] = partial(o_mm_2d, ty_groups=ty_g, tx_groups=tx_g)
+    elif spec["qkv"] == "row":
+        hooks["qkv_mm"] = partial(qkv_mm_row, tp=tp)
+        hooks["o_mm"] = o_mm_row
+    if spec["up"] == "2d":
+        tp_x, tp_y = resolved_tp_mesh(cfg)
+        ty_g, tx_g = tp_subgroups(tp_x, tp_y)
+        hooks["mlp_mm"] = partial(mlp_mm_2d, ty_groups=ty_g,
+                                  tx_groups=tx_g)
+    elif spec["up"] == "row":
+        hooks["mlp_mm"] = partial(mlp_mm_row, tp=tp)
+
+    if hooks:
+        # Strategy exits leave the residual tp-varying (subgroup psums and
+        # all-gathers don't erase the varying type the way the full-axis
+        # psum does); pin the embedding entry to the same type so the
+        # layer-scan carry is stable from layer 0.
+        embed = partial(vocab_parallel_embed, axis="tp")
+        hooks["embed_lookup"] = lambda w, ids: _varying(embed(w, ids))
+    return hooks

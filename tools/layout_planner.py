@@ -139,6 +139,15 @@ def main(argv=None) -> int:
     ap.add_argument("--cp-degrees", type=int, nargs="*", default=None,
                     metavar="CP", help="cp degrees to sweep with "
                          "--cp-crossover (default 2 4 8 16 32)")
+    ap.add_argument("--tp-strategy-table", action="store_true",
+                    help="instead of planning, sweep tp degree and print "
+                         "each TP strategy x sync-mode's predicted step "
+                         "and exposed-comm time per ICI generation, with "
+                         "the best 2D factorization and the adaptive "
+                         "resolution per degree")
+    ap.add_argument("--tp-degrees", type=int, nargs="*", default=None,
+                    metavar="TP", help="tp degrees to sweep with "
+                         "--tp-strategy-table (default 2 4 8 16)")
     ap.add_argument("--validate-sweep", action="store_true",
                     help="score the cost model's rank agreement against "
                          "the measured SWEEP_r03-r05 rows instead of "
@@ -211,6 +220,36 @@ def main(argv=None) -> int:
             print(f"predicted mesh crossover on {gen}: "
                   + (f"cp={cross}" if cross else
                      "never (within swept degrees)"))
+        return 0
+
+    if args.tp_strategy_table:
+        from picotron_tpu.analysis.cost_model import (
+            GENERATIONS, tp_strategy_table,
+        )
+
+        base = build_base_config(args)
+        degrees = tuple(args.tp_degrees or (2, 4, 8, 16))
+        out = [(gen, tp_strategy_table(CostModel(gen), base, degrees))
+               for gen in GENERATIONS]
+        if args.json:
+            for gen, rows in out:
+                print(json.dumps({"generation": gen, "rows": rows}),
+                      flush=True)
+            return 0
+        print(f"TP strategy table: {base.model.name} seq "
+              f"{base.training.seq_length} ('-' = strategy infeasible at "
+              f"that degree; exposed_ms deltas vs megatron-sync)")
+        hdr = ("gen", "tp", "megatron_ms", "deferred_ms", "row_ms",
+               "2d_ms", "2d_mesh", "defer_dexp", "adaptive", "winner")
+        print("  " + "  ".join(h.rjust(11) for h in hdr))
+        for gen, rows in out:
+            for r in rows:
+                cells = (gen, r["tp"], r["megatron_ms"], r["deferred_ms"],
+                         r["row_ms"], r.get("2d_ms", "-"),
+                         r.get("mesh_factorization", "-"),
+                         r["deferred_exposed_delta_ms"],
+                         r["adaptive"], r["winner"])
+                print("  " + "  ".join(str(c).rjust(11) for c in cells))
         return 0
 
     if not args.chips:

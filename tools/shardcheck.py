@@ -102,6 +102,31 @@ PRESETS: dict[str, tuple] = {
                             dict(gradient_accumulation_steps=2,
                                  grad_engine="fused",
                                  remat_policy="dots_attn")),
+    # deferred activation sync (parallel/tp_strategies.py): the audit must
+    # see the block-exit reduce-scatter AND the gather hoisted into the
+    # next block's entry over tp — WITHOUT sequence_parallel set — on both
+    # grad engines (collectives.py deferred presence rule), and the
+    # provenance audit must attribute every tp collective (no implicit
+    # GSPMD reshard from the seq-sharded residual stream)
+    "tiny-tp-deferred": ("debug-tiny",
+                         dict(dp_size=2, tp_size=2, tp_sync="deferred"),
+                         dict(gradient_accumulation_steps=2)),
+    "tiny-tp-deferred-fused": ("debug-tiny",
+                               dict(dp_size=2, tp_size=2,
+                                    tp_sync="deferred"),
+                               dict(gradient_accumulation_steps=2,
+                                    grad_engine="fused",
+                                    remat_policy="dots_attn")),
+    # the 2d tp strategy's subgroup schedule (parallel/tp_strategies.py):
+    # inner tp_y activation/weight all-gathers + outer tp_x partial-sum
+    # all-reduces, audited against the collectives.py 2d presence rule
+    # (kv heads raised to 4 so tp=4 keeps GQA divisibility)
+    "tiny-tp2d": ("debug-tiny",
+                  dict(dp_size=2, tp_size=4, tp_strategy="2d",
+                       tp_mesh="2x2"),
+                  dict(gradient_accumulation_steps=2),
+                  {},
+                  dict(num_key_value_heads=4)),
 }
 
 
@@ -113,9 +138,11 @@ def preset_config(name: str):
 
     model, dist_kw, train_kw, *rest = PRESETS[name]
     pipe_kw = rest[0] if rest else {}
+    model_kw = rest[1] if len(rest) > 1 else {}
     cfg = Config(
         distributed=DistributedConfig(**dist_kw),
-        model=ModelConfig(name=model, **resolve_preset(model)),
+        model=ModelConfig(name=model,
+                          **{**resolve_preset(model), **model_kw}),
         training=TrainingConfig(seq_length=64, micro_batch_size=1,
                                 **train_kw),
         pipeline=PipelineConfig(**pipe_kw),
