@@ -9,6 +9,9 @@ them; `preflight` is train.py's fail-fast subset; tools/shardcheck.py is
 the CLI.
 """
 
+from picotron_tpu.analysis.boundary import (  # noqa: F401
+    ClassifiedOp, SliceTopology, audit_boundary, classify_ops,
+)
 from picotron_tpu.analysis.collectives import (  # noqa: F401
     CollectiveOp, audit_collectives, parse_collectives,
 )

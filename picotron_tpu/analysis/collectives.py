@@ -51,18 +51,21 @@ _DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
                 "i64": 8, "ui64": 8, "i32": 4, "ui32": 4, "i16": 2,
                 "ui16": 2, "i8": 1, "ui8": 1, "i1": 1}
 
-# stablehlo: replica_groups = dense<...> : tensor<GxSxi64>
+# stablehlo: replica_groups = dense<[[0, 1], [2, 3]]> : tensor<GxSxi64>
+# (the member payload is kept so the slice-boundary auditor can map each
+# participant id to its slice — analysis/boundary.py)
 _RE_GROUPS = re.compile(
-    r"replica_groups = dense<[^>]*> : tensor<(\d+)x(\d+)xi64>")
+    r"replica_groups = dense<([^>]*)> : tensor<(\d+)x(\d+)xi64>")
 # stablehlo: source_target_pairs = dense<...> : tensor<Nx2xi64>
 _RE_PAIRS = re.compile(
-    r"source_target_pairs = dense<[^>]*> : tensor<(\d+)x2xi64>")
+    r"source_target_pairs = dense<([^>]*)> : tensor<(\d+)x2xi64>")
 # result types: "-> tensor<1x32x64xbf16>" (take the last on the line)
 _RE_RESULT = re.compile(r"-> tensor<([0-9x]*)x?([a-z]+[0-9]+|i1)>")
 # compiled-HLO dialect (optimized module text): replica_groups={{0,2},{1,3}}
 _RE_HLO_GROUPS = re.compile(r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
 # compiled-HLO iota form: replica_groups=[2,4]<=[8] -> 2 groups of 4
-_RE_HLO_IOTA = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
+_RE_HLO_IOTA = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?")
 _RE_HLO_PAIRS = re.compile(r"source_target_pairs=\{([^}]*)\}")
 _RE_HLO_SHAPE = re.compile(r"=\s*([a-z]+[0-9]+|pred)\[([0-9,]*)\]")
 
@@ -92,6 +95,11 @@ class CollectiveOp:
     shape: Optional[tuple]
     dtype: Optional[str]
     line: int                       # 1-based line in the module text
+    # replica-group membership, when the dialect spells it out: one tuple
+    # of participant ids per group (for collective_permute, one (src, tgt)
+    # tuple per hop). None when only the G x S shape was recoverable —
+    # consumers (analysis/boundary.py) must treat None as unattributable.
+    members: Optional[tuple] = None
 
     @property
     def effective(self) -> bool:
@@ -99,6 +107,57 @@ class CollectiveOp:
         if self.kind == "collective_permute":
             return (self.n_groups or 0) > 0
         return (self.group_size or 0) > 1
+
+
+def _dense_members(payload: str, n_groups: int, group_size: int):
+    """Member tuples from a StableHLO dense<...> payload, or None.
+
+    Handles the explicit `[[0, 1], [2, 3]]` form and the splat form
+    (`dense<0>` for a 1x1 tensor). A payload whose integer count does not
+    match G x S (elided printing) yields None.
+    """
+    ids = [int(t) for t in re.findall(r"-?\d+", payload)]
+    if len(ids) == 1 and n_groups * group_size > 1:
+        ids = ids * (n_groups * group_size)  # splat
+    if len(ids) != n_groups * group_size:
+        return None
+    return tuple(tuple(ids[g * group_size:(g + 1) * group_size])
+                 for g in range(n_groups))
+
+
+def _iota_members(n_groups: int, group_size: int, dims_txt: str,
+                  perm_txt: Optional[str]):
+    """Member tuples from the compiled-HLO iota form
+    `replica_groups=[G,S]<=[d0,d1,...]` (optionally `T(p0,p1,...)`)."""
+    dims = [int(d) for d in dims_txt.split(",") if d]
+    n = math.prod(dims)
+    if n != n_groups * group_size:
+        return None
+    ids = list(range(n))
+    if perm_txt is not None:
+        perm = [int(p) for p in perm_txt.split(",") if p]
+        if sorted(perm) != list(range(len(dims))):
+            return None
+        # reshape iota to `dims`, transpose by `perm`, flatten (row-major)
+        strides = [0] * len(dims)
+        acc = 1
+        for ax in reversed(range(len(dims))):
+            strides[ax] = acc
+            acc *= dims[ax]
+        tdims = [dims[p] for p in perm]
+        tstrides = [strides[p] for p in perm]
+        out = []
+        idx = [0] * len(tdims)
+        for _ in range(n):
+            out.append(sum(i * s for i, s in zip(idx, tstrides)))
+            for ax in reversed(range(len(tdims))):
+                idx[ax] += 1
+                if idx[ax] < tdims[ax]:
+                    break
+                idx[ax] = 0
+        ids = out
+    return tuple(tuple(ids[g * group_size:(g + 1) * group_size])
+                 for g in range(n_groups))
 
 
 def _result_bytes(line: str):
@@ -135,29 +194,48 @@ def parse_collectives(text: str) -> list[CollectiveOp]:
                 break
         if kind is None:
             continue
-        group_size = n_groups = None
+        group_size = n_groups = members = None
         if kind == "collective_permute":
             m = _RE_PAIRS.search(line)
             if m:
-                n_groups = int(m.group(1))
+                n_groups = int(m.group(2))
+                members = _dense_members(m.group(1), n_groups, 2)
             else:
                 m = _RE_HLO_PAIRS.search(line)
                 if m:
-                    n_groups = len([p for p in m.group(1).split("{") if p])
+                    pairs = [p.strip("{}") for p in
+                             m.group(1).split("},{") if p]
+                    n_groups = len(pairs)
+                    try:
+                        members = tuple(
+                            tuple(int(x) for x in p.split(","))
+                            for p in pairs)
+                    except ValueError:
+                        members = None
         else:
             m = _RE_GROUPS.search(line)
             if m:
-                n_groups, group_size = int(m.group(1)), int(m.group(2))
+                n_groups, group_size = int(m.group(2)), int(m.group(3))
+                members = _dense_members(m.group(1), n_groups, group_size)
             else:
                 m = _RE_HLO_IOTA.search(line)
                 if m:
                     n_groups, group_size = int(m.group(1)), int(m.group(2))
+                    members = _iota_members(n_groups, group_size,
+                                            m.group(3), m.group(4))
                 else:
                     m = _RE_HLO_GROUPS.search(line)
                     if m:
-                        groups = m.group(1).split("},{")
+                        groups = [g.strip("{}") for g in
+                                  m.group(1).split("},{")]
                         n_groups = len(groups)
-                        group_size = len(groups[0].strip("{}").split(","))
+                        group_size = len(groups[0].split(","))
+                        try:
+                            members = tuple(
+                                tuple(int(x) for x in g.split(","))
+                                for g in groups)
+                        except ValueError:
+                            members = None
         # result type: same line for region-free ops, else the region's
         # closing `}) : (...) -> type` a few lines down
         nbytes = dims = dtype = None
@@ -176,7 +254,7 @@ def parse_collectives(text: str) -> list[CollectiveOp]:
                 dims = tuple(int(d) for d in m.group(2).split(",") if d)
                 nbytes = math.prod(dims) * _DTYPE_BYTES.get(dtype, 4)
         ops.append(CollectiveOp(kind, group_size, n_groups, nbytes, dims,
-                                dtype, i + 1))
+                                dtype, i + 1, members))
     return ops
 
 

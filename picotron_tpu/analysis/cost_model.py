@@ -72,13 +72,25 @@ class IciGeneration:
     peak_flops: float       # per-chip bf16 peak FLOP/s
     pcie_bandwidth: float   # host<->device streaming bw (offload); see
                             # Calibration — fitted, this is the fallback
+    # -- the dcn tier (multi-slice scale-out) -----------------------------
+    # Per-slice-exit DCN bandwidth per direction. Slices connect through
+    # the data-center network at per-host NIC rates aggregated across the
+    # slice boundary — order 50-100 Gb/s per host vs 360-800 Gb/s per
+    # chip of ICI. Analytic defaults (derated published figures) awaiting
+    # on-TPU multi-slice validation; PERF.md round 16 has the protocol.
+    dcn_bandwidth: float = 6.25e9   # bytes/s across the cut per direction
+    dcn_alpha_s: float = 2.0e-5     # per-transfer DCN latency (vs 1 µs ICI)
 
 
 GENERATIONS: dict[str, IciGeneration] = {
-    "v4": IciGeneration("v4", 3, 45e9, 4, 32.0, 275e12, 7e9),
-    "v5e": IciGeneration("v5e", 2, 45e9, 16, 16.0, 197e12, 7e9),
-    "v5p": IciGeneration("v5p", 3, 90e9, 4, 95.0, 459e12, 7e9),
-    "v6e": IciGeneration("v6e", 2, 100e9, 16, 32.0, 918e12, 7e9),
+    "v4": IciGeneration("v4", 3, 45e9, 4, 32.0, 275e12, 7e9,
+                        6.25e9, 2.0e-5),
+    "v5e": IciGeneration("v5e", 2, 45e9, 16, 16.0, 197e12, 7e9,
+                         6.25e9, 2.0e-5),
+    "v5p": IciGeneration("v5p", 3, 90e9, 4, 95.0, 459e12, 7e9,
+                         12.5e9, 2.0e-5),
+    "v6e": IciGeneration("v6e", 2, 100e9, 16, 32.0, 918e12, 7e9,
+                         12.5e9, 2.0e-5),
 }
 
 
@@ -184,6 +196,25 @@ def split_cp_link(link: AxisLink, cp_x: int, cp_y: int,
     outer = AxisLink(link.axis, cp_x, outer_kind,
                      link.bandwidth / max(cp_y, 1), link.stride * cp_y)
     return outer, inner
+
+
+def split_slice_link(link: AxisLink, n_slices: int,
+                     gen: IciGeneration) -> tuple[AxisLink, AxisLink]:
+    """Factor one placed DCN-crossing axis into its hierarchical tiers:
+    (intra-slice ICI sub-link of size n/slices, inter-slice DCN link of
+    size slices). The intra leg keeps the parent's bandwidth/stride and
+    re-derives its wrap rule from the shrunk size; the DCN leg is modeled
+    as a bidirectional ring of slices at the generation's dcn_bandwidth
+    (slice interconnects are switched, so a ring is the conservative
+    shape). Mirrors split_cp_link's role for the mesh cp flavor — the
+    slice-boundary analogue of the TASP follow-the-network split."""
+    m = max(link.size // max(n_slices, 1), 1)
+    intra = AxisLink(link.axis, m,
+                     "ring" if m >= gen.wrap_min else "line",
+                     link.bandwidth, link.stride)
+    dcn = AxisLink(f"{link.axis}@dcn", n_slices, "ring",
+                   gen.dcn_bandwidth, 1)
+    return intra, dcn
 
 
 # ---------------------------------------------------------------------------
@@ -343,15 +374,17 @@ class CostModel:
     # -- per-collective ----------------------------------------------------
 
     def collective_secs(self, kind: str, nbytes: float,
-                        link: AxisLink) -> float:
+                        link: AxisLink, alpha: float = None) -> float:
         """Seconds for one collective of `kind` moving `nbytes` (the full
         logical tensor for group collectives; the per-device payload for a
-        ppermute shift) over one placed axis."""
+        ppermute shift) over one placed axis. `alpha` overrides the
+        per-hop latency (the dcn tier's is ~20x the ICI default)."""
         n, bw = link.size, link.bandwidth
         if n <= 1 or nbytes <= 0:
             return 0.0
         dirs = link.directions
-        alpha = self.calib.alpha_link_s
+        if alpha is None:
+            alpha = self.calib.alpha_link_s
         if kind == "all_gather" or kind == "reduce_scatter":
             return nbytes * (n - 1) / n / (dirs * bw) + alpha * (n - 1)
         if kind == "all_reduce":
@@ -372,6 +405,71 @@ class CostModel:
         return place_axes({"dp": d.dp_size, "pp": d.pp_size,
                            "ep": d.ep_size, "cp": d.cp_size,
                            "tp": d.tp_size}, self.gen)
+
+    # -- the dcn tier -----------------------------------------------------
+
+    def dcn_link(self, n_slices: int) -> AxisLink:
+        """The inter-slice DCN 'axis': a ring of slices at the
+        generation's dcn_bandwidth."""
+        return AxisLink("dcn", n_slices, "ring", self.gen.dcn_bandwidth, 1)
+
+    def dcn_secs(self, kind: str, nbytes: float, n_slices: int) -> float:
+        """Seconds for one collective leg crossing the slice cut — same
+        ring formulas as ICI, at the dcn tier's bandwidth and latency."""
+        return self.collective_secs(kind, nbytes, self.dcn_link(n_slices),
+                                    alpha=self.gen.dcn_alpha_s)
+
+    def slice_tiers(self, cfg: Config, n_slices: int, axis: str) -> dict:
+        """Price the predicted step comm under a slice cut on `axis`
+        (one of the DCN-tolerant axes, dp or pp): comm terms spanning the
+        axis are re-priced hierarchically — wide legs on the intra-slice
+        ICI sub-link, a shard-per-slice leg on the dcn tier — and
+        everything else stays on its placed ICI link. Returns the per-tier
+        split the planner renders: which axis should absorb the slice
+        granules falls out of comparing these rows."""
+        cost = self.predict(cfg)
+        links = self.axes_for(cfg)
+        d = cfg.distributed
+        axis_size = {"dp": d.dp_size, "pp": d.pp_size}.get(axis, 1)
+        ici_s = dcn_s = 0.0
+        dcn_bytes = 0.0
+        crossing = []
+        for t in cost.comm:
+            if axis not in t.axes or axis not in links:
+                ici_s += t.secs_total
+                continue
+            crossing.append(t.name)
+            intra, dcn = split_slice_link(links[axis], n_slices, self.gen)
+            other_s = sum(self.collective_secs(t.kind, t.bytes_each,
+                                               links[a])
+                          for a in t.axes if a != axis and a in links)
+            if t.kind == "collective_permute":
+                # the boundary pairs at the cut cross DCN point-to-point;
+                # in-slice pairs keep the ICI price
+                ici_s += t.count * (other_s + self.collective_secs(
+                    t.kind, t.bytes_each, intra))
+                dcn_leg = (t.bytes_each / self.gen.dcn_bandwidth
+                           + self.gen.dcn_alpha_s)
+                dcn_s += t.count * dcn_leg
+                dcn_bytes += t.count * t.bytes_each
+            else:
+                m = max(axis_size // n_slices, 1)
+                ici_s += t.count * (other_s + self.collective_secs(
+                    t.kind, t.bytes_each, intra))
+                shard = t.bytes_each / m
+                dcn_s += t.count * self.dcn_secs(t.kind, shard, n_slices)
+                dcn_bytes += t.count * shard * (
+                    2 if t.kind == "all_reduce" else 1) * (
+                    n_slices - 1) / n_slices
+        return {
+            "axis": axis, "slices": n_slices,
+            "generation": self.gen.name,
+            "crossing_terms": crossing,
+            "dcn_bytes": int(dcn_bytes),
+            "dcn_ms": round(dcn_s * 1e3, 4),
+            "ici_ms": round(ici_s * 1e3, 4),
+            "total_comm_ms": round((ici_s + dcn_s) * 1e3, 4),
+        }
 
     # -- traced-schedule pricing ------------------------------------------
 

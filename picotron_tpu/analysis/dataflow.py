@@ -493,6 +493,22 @@ def _nested_boundaries(cfg, jaxpr, var_root, declared) -> list:
 # ---------------------------------------------------------------------------
 
 
+def attribution_by_line(cfg, low) -> dict:
+    """Lowered line -> Python source location for every attributed
+    collective. The lookup the slice-boundary auditor (analysis/
+    boundary.py) uses to name the site that minted a violating op —
+    'ring ppermute from ops/ring_attention.py:118 crosses the cut' is
+    actionable where a bare StableHLO line number is not. Empty when this
+    JAX exposes no pre-lowering jaxpr."""
+    if getattr(low, "jaxpr", None) is None:
+        return {}
+    paths = root_paths(low.state, low.batch)
+    sites = collect_sites(low.jaxpr, paths)
+    ops = [op for op in parse_collectives(low.text) if op.effective]
+    attributed, _ = attribute_collectives(cfg, sites, ops)
+    return {op.line: site.source for op, site in attributed}
+
+
 def compiled_collectives(lowered) -> list:
     """Effective collectives of the OPTIMIZED module — after SPMD
     partitioning, so GSPMD-minted reshards are visible (they never appear

@@ -440,3 +440,26 @@ def planner_gap(cfg: Config, model: Optional[CostModel] = None,
     gap = ((cur.total_s / cur.tokens_per_step)
            / (best.cost.total_s / best.cost.tokens_per_step) - 1.0)
     return cur, best, gap
+
+
+def slice_plans(cfg: Config, model: Optional[CostModel] = None,
+                n_slices: Optional[int] = None) -> list[dict]:
+    """Enumerate which DCN-tolerant axis (dp or pp) can absorb the slice
+    granules for this layout and price both network tiers for each legal
+    split (CostModel.slice_tiers): the intra-slice ICI legs and the
+    shard-per-slice DCN leg of the hierarchical decomposition. Rows are
+    ranked by total comm — the top row is the boundary the layout should
+    declare in `distributed.dcn_axes`. Empty when no axis can absorb the
+    slice count (the same divisibility rule mesh._split_axes_over_dcn
+    enforces)."""
+    model = model or CostModel()
+    s = n_slices if n_slices is not None else cfg.distributed.slices
+    if s <= 1:
+        return []
+    d = cfg.distributed
+    rows = []
+    for axis, size in (("dp", d.dp_size), ("pp", d.pp_size)):
+        if size >= s and size % s == 0:
+            rows.append(model.slice_tiers(cfg, s, axis))
+    rows.sort(key=lambda r: r["total_comm_ms"])
+    return rows

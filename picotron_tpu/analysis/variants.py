@@ -231,9 +231,15 @@ def prove_mpmd_stages(cfg, menv=None) -> Report:
     submesh shardings the executor device_puts. audit_feeds then closes
     each entry's signature space; a stage whose scheduled calls disagree
     in abstract signature (a second executable minted mid-schedule) is an
-    ERROR, which shardcheck renders as a fatal row."""
+    ERROR, which shardcheck renders as a fatal row.
+
+    The schedule TABLE itself is also re-linted here (mpmd.lint_schedule:
+    balanced produce/consume per boundary buffer, no consume-before-
+    produce, bounded in-flight live set) so the CLI surfaces the same
+    static proof build_schedule enforces at construction time."""
     from picotron_tpu.mesh import MeshEnv
-    from picotron_tpu.parallel.mpmd import mpmd_entry_feeds
+    from picotron_tpu.parallel.mpmd import (build_schedule, lint_schedule,
+                                            mpmd_entry_feeds)
 
     rep = Report()
     menv = menv if menv is not None else MeshEnv.from_config(cfg)
@@ -246,8 +252,20 @@ def prove_mpmd_stages(cfg, menv=None) -> Report:
         info = sub.info.get(CHECK, {})
         entries[entry] = info
         proven_all = proven_all and bool(info.get("proven"))
+    n_micro = cfg.training.gradient_accumulation_steps
+    pp = cfg.distributed.pp_size
+    kind = cfg.pipeline.schedule
+    table = build_schedule(kind, n_micro, pp, cfg.pipeline.interleave)
+    problems = lint_schedule(table, n_micro, pp, cfg.pipeline.interleave,
+                             kind=kind)
+    for p in problems:  # unreachable via build_schedule (it raises), but
+        rep.add(CHECK, ERROR, "schedule", p)  # guards future generators
+    lint_info = {"kind": kind, "ops": len(table),
+                 "ticks": (max(op.tick for op in table) + 1) if table else 0,
+                 "problems": len(problems), "proven": not problems}
     rep.info[CHECK] = {"entry": "mpmd_stages", "programs": len(feeds),
-                       "proven": proven_all, "entries": entries}
+                       "proven": proven_all and not problems,
+                       "entries": entries, "schedule_lint": lint_info}
     if proven_all:
         rep.add(CHECK, INFO, "mpmd_stages",
                 f"compile-once proven for all {len(feeds)} stage programs "

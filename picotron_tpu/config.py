@@ -349,6 +349,20 @@ class DistributedConfig:
     # 2D strategy factorization "XxY" (tp_x x tp_y, tp_x * tp_y == tp_size);
     # "" picks the most-square feasible factorization (resolved_tp_mesh).
     tp_mesh: str = ""
+    # Multi-slice topology: number of TPU slices joined over DCN (the
+    # data-center network). 1 = single slice, everything on ICI. When > 1
+    # the slice granules are absorbed into the DCN-tolerant axes (dp first,
+    # then pp — mesh._split_axes_over_dcn) and the static slice-boundary
+    # auditor (analysis/boundary.py) proves at preflight that only
+    # collectives over the declared dcn_axes cross the cut.
+    slices: int = 1
+    # Which mesh axes are DECLARED as allowed to cross the inter-slice DCN
+    # link, comma-separated subset of "dp,pp". The auditor classifies every
+    # traced replica group against this declaration: groups on a declared
+    # axis that straddle the cut are "boundary" (expected, priced at the
+    # dcn tier); groups on any other axis that straddle it are
+    # "violating" (a named preflight error).
+    dcn_axes: str = "dp,pp"
     # Accepted for reference-JSON compatibility; ignored (XLA picks transport).
     backend: str = "jax"
     use_cpu: bool = False
@@ -399,6 +413,48 @@ class DistributedConfig:
                 raise ValueError(
                     f"tp_mesh '{self.tp_mesh}' must factor the tp degree: "
                     f"{tp_x} * {tp_y} != tp_size ({self.tp_size})")
+        if self.slices < 1:
+            raise ValueError(f"slices must be >= 1, got {self.slices}")
+        axes = parse_dcn_axes(self.dcn_axes)
+        if self.slices > 1:
+            # Mirror mesh._split_axes_over_dcn: the slice count must divide
+            # dp*pp so ep/cp/tp collectives stay on ICI. The declaration may
+            # be NARROWER than the house rule (that is how a mis-declared
+            # layout is caught by the boundary auditor), but it cannot name
+            # an ICI-only axis.
+            if self.slices > self.dp_size * self.pp_size or (
+                    self.dp_size * self.pp_size) % self.slices != 0:
+                raise ValueError(
+                    f"slices ({self.slices}) must divide dp*pp "
+                    f"({self.dp_size}*{self.pp_size}="
+                    f"{self.dp_size * self.pp_size}) — ep/cp/tp collectives "
+                    "must stay on ICI. Rebalance the layout so dp*pp "
+                    "absorbs the slice count.")
+            if not axes:
+                raise ValueError(
+                    "dcn_axes must declare at least one crossing axis "
+                    "when slices > 1 (subset of 'dp,pp')")
+
+
+DCN_TOLERANT_AXES = ("dp", "pp")
+
+
+def parse_dcn_axes(spec: str) -> tuple[str, ...]:
+    """Parse a dcn_axes declaration into an ordered (dp-first) axis tuple.
+
+    Accepts a comma-separated subset of the DCN-tolerant axes ("dp", "pp");
+    the empty string means no axis is declared (only legal at slices == 1).
+    """
+    axes = tuple(a.strip() for a in spec.split(",") if a.strip())
+    bad = [a for a in axes if a not in DCN_TOLERANT_AXES]
+    if bad:
+        raise ValueError(
+            f"dcn_axes may only name the DCN-tolerant axes "
+            f"{DCN_TOLERANT_AXES} (got {bad} in {spec!r}) — ep/cp/tp "
+            "collectives must stay on ICI")
+    if len(set(axes)) != len(axes):
+        raise ValueError(f"dcn_axes has duplicate axes: {spec!r}")
+    return tuple(a for a in DCN_TOLERANT_AXES if a in axes)
 
 
 def _parse_mesh2(spec: str, field: str) -> tuple[int, int]:

@@ -198,7 +198,108 @@ def build_schedule(kind: str, n_micro: int, pp: int,
         raise RuntimeError(
             f"schedule simulator stalled at {len(ops)}/{total} ops "
             f"(kind={kind}, n={n_micro}, pp={pp}, v={interleave})")
+    problems = lint_schedule(ops, n_micro, pp, interleave, kind=kind)
+    if problems:
+        raise ScheduleBufferError(
+            f"schedule table fails the static lint (kind={kind}, "
+            f"n={n_micro}, pp={pp}, v={interleave}): "
+            f"{'; '.join(problems)}")
     return ops
+
+
+def lint_schedule(table: list, n_micro: int, pp: int,
+                  interleave: int = 1, kind: str = None) -> list[str]:
+    """Static schedule-table lint: walk the table with the exact
+    produce/consume rules `_run_schedule` applies at runtime and return
+    every problem as a string — the `ScheduleBufferError` contract proven
+    BEFORE any schedule runs, instead of after a wasted walk.
+
+    Three rule families:
+
+    - **consume-before-produce**: an op that pops an activation /
+      cotangent / saved-input buffer no earlier op filled would KeyError
+      mid-walk at runtime (a dependency-broken table);
+    - **balanced produce/consume**: the end-of-walk live set must be
+      empty per (vstage, mb) buffer key — leftovers are orphaned tensors
+      some dispatched op produced and nothing consumed (a truncated
+      table), exactly what the runtime assert at the end of
+      `_run_schedule` reports today;
+    - **bounded live set**: the peak number of saved stage inputs per
+      virtual stage must not exceed the schedule's in-flight budget —
+      n_micro for gpipe (all-forward-then-all-backward legitimately
+      saves everything), min(n_micro, 2*pp*v) per vstage otherwise.
+      The greedy backward-first simulator's warmup depth at early
+      stages reaches 2*pp - 3 (measured across pp up to 16), so the
+      bound tracks twice the pipeline depth, widened by the interleave
+      factor. A table over budget would OOM activations on hardware
+      even though it drains cleanly.
+
+    The zb split's BX carries B's buffer rules and BW is buffer-neutral
+    (weight-grad only). Exposed through `shardcheck --variants`
+    (analysis/variants.py) so a schedule bug is a static finding."""
+    V = pp * (interleave if interleave > 1 else 1)
+    if V < 2:
+        return []
+    problems: list[str] = []
+    names = {"x": "activation", "s": "saved-input", "g": "cotangent"}
+    live: dict = {}            # ("x"|"s"|"g", vstage, mb) -> True
+    peak_saved: dict = {}      # vstage -> peak live saved-inputs
+    n_saved: dict = {}
+
+    def produce(b, j, m):
+        live[(b, j, m)] = True
+        if b == "s":
+            n_saved[j] = n_saved.get(j, 0) + 1
+            peak_saved[j] = max(peak_saved.get(j, 0), n_saved[j])
+
+    def consume(b, j, m, op):
+        if not live.pop((b, j, m), None):
+            problems.append(
+                f"{op.op}@tick{op.tick} (vstage={op.vstage}, mb={op.mb}) "
+                f"consumes {names[b]} (vstage={j}, mb={m}) never produced")
+        elif b == "s":
+            n_saved[j] -= 1
+
+    for op in sorted(table, key=lambda o: (o.tick, o.group)):
+        j, m = op.vstage, op.mb
+        if op.op == "F":
+            if j == 0:
+                produce("x", j + 1, m)
+            elif j == V - 1:
+                consume("x", j, m, op)
+                produce("s", j, m)
+            else:
+                consume("x", j, m, op)
+                produce("s", j, m)
+                produce("x", j + 1, m)
+        elif op.op in ("B", "BX"):
+            if j == V - 1:
+                consume("s", j, m, op)
+                produce("g", j - 1, m)
+            elif j == 0:
+                consume("g", j, m, op)
+            else:
+                consume("s", j, m, op)
+                consume("g", j, m, op)
+                produce("g", j - 1, m)
+        # BW: weight-grad half, touches no boundary buffers
+    leftover = sorted(live)
+    if leftover:
+        keys = "; ".join(f"{names[b]} (vstage={j}, mb={m})"
+                         for b, j, m in leftover)
+        problems.append(
+            f"{len(leftover)} live boundary buffer(s) at end of walk — "
+            f"produced but never consumed: {keys}")
+    v = interleave if interleave > 1 else 1
+    budget = n_micro if kind == "gpipe" else min(n_micro, 2 * pp * v)
+    for j, peak in sorted(peak_saved.items()):
+        if peak > budget:
+            problems.append(
+                f"vstage {j} holds {peak} saved inputs at peak, over the "
+                f"schedule's in-flight budget of {budget} — the table "
+                f"defers backwards past the {kind or 'schedule'} "
+                f"in-flight depth (activation OOM on hardware)")
+    return problems
 
 
 def schedule_stats(kind: str, n_micro: int, pp: int,

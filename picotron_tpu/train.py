@@ -233,6 +233,16 @@ def main(argv=None) -> None:
         if ts.get("proven"):
             log_print("shardflow: train step proven compile-once "
                       f"({ts['leaves']} abstract leaves, 1 signature)")
+        bnd = pre.info.get("boundary", {})
+        if bnd.get("audited"):
+            # slicecheck (analysis/boundary.py): the preflight raised above
+            # on any ICI-only axis straddling the DCN cut, so reaching
+            # here means every crossing collective is a declared one
+            log_print(f"slicecheck: {bnd['slices']} slices, cut on "
+                      f"[{bnd['cut_axes']}] — {bnd['boundary']} declared "
+                      f"boundary op(s) over [{bnd['dcn_axes']}], "
+                      f"{bnd['intra']} intra-slice, 0 violating "
+                      f"({bnd['dcn_bytes']} B/step across DCN)")
         if cfg.checkpoint.save_frequency > 0:
             # Same fail-fast contract for the checkpoint store: an
             # unwritable save_dir or a disk without headroom for one

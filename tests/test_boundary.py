@@ -1,0 +1,378 @@
+"""slicecheck tests: the slice-boundary auditor (analysis/boundary.py),
+the cost model's DCN tier, the multislice planner rows, and the static
+schedule-table lint (parallel/mpmd.lint_schedule).
+
+Protocol: the 8 simulated host devices are partitioned into 2 declared
+"slices" (tests/conftest.py::slice_partition). The crossing presets pin
+that every collective of a correctly declared layout lands in a tier
+(intra-slice or declared-boundary, zero violating), a deliberately
+mis-declared layout is caught with a named `ici-axis-over-dcn` error,
+and the two hierarchical-decomposition mutations the issue names —
+deleting the intra-slice reduce-scatter leg, widening the DCN all-reduce
+group — each trip a named rule (the PR-15 mutation-test pattern)."""
+
+import dataclasses
+
+import pytest
+
+from picotron_tpu.analysis.boundary import (
+    SliceTopology, audit_boundary, classify_ops,
+)
+from picotron_tpu.analysis.collectives import parse_collectives
+from picotron_tpu.config import (
+    Config, DistributedConfig, ModelConfig, PipelineConfig, TrainingConfig,
+    parse_dcn_axes, resolve_preset,
+)
+
+
+def mkcfg(model="debug-tiny", dist=None, train=None, pipe=None):
+    cfg = Config(
+        distributed=DistributedConfig(**(dist or {})),
+        model=ModelConfig(name=model, **resolve_preset(model)),
+        training=TrainingConfig(seq_length=64, micro_batch_size=1,
+                                **(train or {})),
+        pipeline=PipelineConfig(**(pipe or {})),
+    )
+    cfg.validate()
+    return cfg
+
+
+def dp_cross_cfg():
+    return mkcfg(dist=dict(dp_size=2, tp_size=2, cp_size=2,
+                           slices=2, dcn_axes="dp"),
+                 train=dict(gradient_accumulation_steps=2))
+
+
+def pp_cross_cfg():
+    return mkcfg(dist=dict(pp_size=2, tp_size=2, slices=2, dcn_axes="pp"),
+                 train=dict(gradient_accumulation_steps=2),
+                 pipe=dict(executor="mpmd"))
+
+
+@pytest.fixture(scope="module")
+def dp_cross_text():
+    from picotron_tpu.analysis.trace import lower_train_step
+
+    return lower_train_step(dp_cross_cfg()).text
+
+
+# ---------------------------------------------------------------------------
+# config surface
+# ---------------------------------------------------------------------------
+
+
+def test_parse_dcn_axes():
+    assert parse_dcn_axes("dp,pp") == ("dp", "pp")
+    assert parse_dcn_axes("pp,dp") == ("dp", "pp")  # dp-first order
+    assert parse_dcn_axes("pp") == ("pp",)
+    assert parse_dcn_axes("") == ()
+    with pytest.raises(ValueError, match="tp"):
+        parse_dcn_axes("dp,tp")  # ICI-only axis can never cross DCN
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_dcn_axes("dp,dp")
+
+
+def test_config_validates_slice_divisibility():
+    with pytest.raises(ValueError, match="slices"):
+        mkcfg(dist=dict(dp_size=2, tp_size=2, cp_size=2, slices=3,
+                        dcn_axes="dp"),
+              train=dict(gradient_accumulation_steps=2))
+    with pytest.raises(ValueError, match="dcn_axes"):
+        mkcfg(dist=dict(dp_size=2, tp_size=2, cp_size=2, slices=2,
+                        dcn_axes=""),
+              train=dict(gradient_accumulation_steps=2))
+
+
+# ---------------------------------------------------------------------------
+# SliceTopology: device -> slice mapping
+# ---------------------------------------------------------------------------
+
+
+def test_slice_topology_matches_session_partition(slice_partition):
+    """The house rule (granule = OUTER factor of the first cut axis on
+    the row-major grid) maps the 8 simulated devices exactly onto the
+    session's positional 2-slice partition."""
+    topo = SliceTopology.from_config(dp_cross_cfg())
+    assert topo.n_slices == 2
+    assert topo.declared == ("dp",)
+    assert topo.cut_axes == ("dp",)
+    for sl, ids in slice_partition.items():
+        for d in ids:
+            assert topo.slice_of(d) == sl, (d, sl)
+
+
+def test_slice_topology_pp_cut():
+    topo = SliceTopology.from_config(pp_cross_cfg())
+    assert topo.cut_axes == ("pp",)
+    # grid (dp=1, pp=2, ep=1, cp=1, tp=2 -> padded to 8 by dp? no: world=4)
+    # row-major (dp, pp, ep, cp, tp): id = pp*2 + tp; pp coord is id // 2
+    for d in range(4):
+        assert topo.slice_of(d) == d // 2
+
+
+def test_slice_topology_rejects_indivisible():
+    cfg = dp_cross_cfg()
+    with pytest.raises(ValueError):
+        SliceTopology.from_config(cfg, n_slices=3)
+
+
+# ---------------------------------------------------------------------------
+# replica-group membership parsing
+# ---------------------------------------------------------------------------
+
+
+def test_parse_collectives_members(dp_cross_text):
+    ops = parse_collectives(dp_cross_text)
+    assert ops, "no collectives in the dp-cross lowering"
+    with_members = [o for o in ops if o.members is not None]
+    # the StableHLO dense<...> dialect carries explicit member lists:
+    # membership must be recovered for (at least) every grouped op
+    assert len(with_members) >= len(ops) - 2, (len(with_members), len(ops))
+    for o in with_members:
+        if o.kind == "collective_permute":
+            assert all(len(pair) == 2 for pair in o.members)
+        else:
+            assert len(o.members) == o.n_groups
+            assert all(len(g) == o.group_size for g in o.members)
+
+
+# ---------------------------------------------------------------------------
+# classification: crossing presets are green, mis-declaration is caught
+# ---------------------------------------------------------------------------
+
+
+def test_dp_cross_audit_green(dp_cross_text):
+    cfg = dp_cross_cfg()
+    rep = audit_boundary(cfg, text=dp_cross_text)
+    assert rep.ok(), rep.render(verbose=True)
+    info = rep.info["boundary"]
+    assert info["audited"] and info["slices"] == 2
+    assert info["violating"] == 0 and info["unattributable"] == 0
+    assert info["boundary"] > 0, "dp crossers must exist"
+    assert info["intra"] > 0, "tp/cp collectives must stay inside"
+    assert info["dcn_bytes"] > 0 and info["ici_bytes"] > 0
+    # every intra op carries zero DCN bytes and vice versa
+    for row in info["table"]:
+        if row["class"] == "intra":
+            assert row["dcn_bytes"] == 0
+        if row["class"] == "boundary":
+            assert row["dcn_bytes"] > 0
+
+
+def test_pp_cross_audit_green_via_shardcheck():
+    from picotron_tpu.analysis import run_shardcheck
+
+    rep = run_shardcheck(pp_cross_cfg())
+    assert rep.ok(), rep.render(verbose=True)
+    info = rep.info["boundary"]
+    assert info["audited"] and info["violating"] == 0
+    # the stage-boundary ppermutes are declared crossers
+    kinds = {r["kind"] for r in info["table"] if r["class"] == "boundary"}
+    assert "collective_permute" in kinds
+    # satellite 1: the static schedule-table lint surfaces through the
+    # variants info for MPMD configs
+    lint = rep.info["variants"]["mpmd_stages"]["schedule_lint"]
+    assert lint["proven"] and lint["problems"] == 0
+    assert lint["kind"] == "1f1b" and lint["ops"] > 0
+
+
+def test_misdeclared_axis_is_a_named_violation(dp_cross_text):
+    """Declaring pp as the crossing axis while the house rule cuts dp
+    routes every ICI-only dp collective over DCN — each one is a named
+    `ici-axis-over-dcn` error and shardcheck goes red."""
+    cfg = dp_cross_cfg()
+    rep = audit_boundary(cfg, text=dp_cross_text, dcn_axes="pp")
+    assert not rep.ok()
+    errs = [f for f in rep.errors() if "ici-axis-over-dcn" in f.message]
+    assert errs, rep.render(verbose=True)
+    assert rep.info["boundary"]["violating"] == len(errs)
+    assert rep.info["boundary"]["boundary"] == 0
+
+
+def test_violation_names_the_minting_source():
+    """Through the runner (which hands the auditor the full lowering),
+    a violating op is attributed to the Python site that minted it —
+    actionable where a bare StableHLO line number is not."""
+    from picotron_tpu.analysis import run_shardcheck
+
+    cfg = mkcfg(dist=dict(dp_size=2, pp_size=2, tp_size=2,
+                          slices=2, dcn_axes="pp"),
+                train=dict(gradient_accumulation_steps=2))
+    rep = run_shardcheck(cfg, checks=("spec", "boundary"))
+    errs = [f for f in rep.errors() if "ici-axis-over-dcn" in f.message]
+    assert errs, rep.render(verbose=True)
+    assert any("minted at" in f.message and ".py:" in f.message
+               for f in errs), errs[0].message
+
+
+def test_single_slice_is_a_no_op(dp_cross_text):
+    cfg = mkcfg(dist=dict(dp_size=2, tp_size=2, cp_size=2),
+                train=dict(gradient_accumulation_steps=2))
+    rep = audit_boundary(cfg, text=dp_cross_text)
+    assert rep.ok()
+    assert rep.info["boundary"] == {"slices": 1, "audited": False}
+
+
+# ---------------------------------------------------------------------------
+# hierarchical-decomposition mutations (the PR-15 pattern)
+# ---------------------------------------------------------------------------
+
+_GRAD_GROUPS = "[[0, 2, 4, 6], [1, 3, 5, 7]]"
+
+
+def test_mutation_deleted_intra_scatter_leg(dp_cross_text):
+    """Rewriting the fused-dp grad groups so each crossing group keeps a
+    per-slice cohort of 1 deletes the intra-slice reduce-scatter leg of
+    the hierarchical decomposition: full-width gradients would cross DCN
+    instead of one shard per slice. Named rule: hier_intra_scatter."""
+    assert _GRAD_GROUPS in dp_cross_text, \
+        "lowering changed; update the mutation fixture"
+    mutated = dp_cross_text.replace(
+        _GRAD_GROUPS, "[[0, 4], [2, 6], [1, 5], [3, 7]]").replace(
+        "tensor<2x4xi64>", "tensor<4x2xi64>")
+    rep = audit_boundary(dp_cross_cfg(), text=mutated)
+    assert not rep.ok()
+    assert any(f.path == "hier_intra_scatter" for f in rep.errors()), \
+        rep.render(verbose=True)
+
+
+def test_mutation_widened_dcn_group(dp_cross_text):
+    """Unbalancing a crossing group (3 members on one slice, 1 on the
+    other) widens the DCN leg past one shard per slice. Named rule:
+    hier_dcn_cohort."""
+    mutated = dp_cross_text.replace(
+        _GRAD_GROUPS, "[[0, 2, 4, 1], [6, 3, 5, 7]]")
+    rep = audit_boundary(dp_cross_cfg(), text=mutated)
+    assert not rep.ok()
+    assert any(f.path == "hier_dcn_cohort" for f in rep.errors()), \
+        rep.render(verbose=True)
+
+
+# ---------------------------------------------------------------------------
+# cost model: the dcn tier
+# ---------------------------------------------------------------------------
+
+
+def test_generations_carry_dcn_descriptors():
+    from picotron_tpu.analysis.cost_model import Calibration, GENERATIONS
+
+    for name, gen in GENERATIONS.items():
+        assert gen.dcn_bandwidth > 0, name
+        assert gen.dcn_alpha_s > 0, name
+        # DCN is the slow tier by construction
+        assert gen.dcn_bandwidth < gen.link_bandwidth, name
+        assert gen.dcn_alpha_s > Calibration().alpha_link_s, name
+
+
+def test_dcn_secs_prices_the_slow_tier():
+    from picotron_tpu.analysis.cost_model import CostModel
+
+    m = CostModel("v5e")
+    small = m.dcn_secs("all_reduce", 1 << 20, 2)
+    big = m.dcn_secs("all_reduce", 1 << 24, 2)
+    assert 0 < small < big
+    # same bytes over ICI are far cheaper than over DCN
+    link = m.dcn_link(2)
+    assert link.axis == "dcn" and link.size == 2
+    assert link.bandwidth == m.gen.dcn_bandwidth
+
+
+def test_split_slice_link():
+    from picotron_tpu.analysis.cost_model import (
+        AxisLink, GENERATIONS, split_slice_link,
+    )
+
+    gen = GENERATIONS["v5e"]
+    parent = AxisLink("dp", 8, "ring", gen.link_bandwidth, 1)
+    intra, dcn = split_slice_link(parent, 2, gen)
+    assert intra.size == 4 and intra.axis == "dp"
+    assert intra.bandwidth == parent.bandwidth
+    assert dcn.size == 2 and dcn.bandwidth == gen.dcn_bandwidth
+
+
+def test_slice_tiers_and_slice_plans():
+    from picotron_tpu.analysis.cost_model import CostModel
+    from picotron_tpu.analysis.planner import slice_plans
+
+    cfg = mkcfg(dist=dict(dp_size=4, pp_size=2),
+                train=dict(gradient_accumulation_steps=2))
+    rows = slice_plans(cfg, CostModel("v5e"), n_slices=2)
+    assert {r["axis"] for r in rows} == {"dp", "pp"}
+    for r in rows:
+        assert r["slices"] == 2 and r["generation"] == "v5e"
+        assert r["dcn_bytes"] > 0 and r["dcn_ms"] > 0
+        assert r["total_comm_ms"] > 0
+        assert r["crossing_terms"], r
+    # ranked by total comm, best first
+    assert rows == sorted(rows, key=lambda r: r["total_comm_ms"])
+    # no legal axis -> empty (tp cannot absorb slices)
+    solo = mkcfg(dist=dict(tp_size=2))
+    assert slice_plans(solo, CostModel("v5e"), n_slices=2) == []
+    assert slice_plans(cfg, CostModel("v5e"), n_slices=1) == []
+
+
+def test_audit_prices_tiers_with_cost_model(dp_cross_text):
+    from picotron_tpu.analysis.cost_model import CostModel
+
+    rep = audit_boundary(dp_cross_cfg(), text=dp_cross_text,
+                         cost_model=CostModel("v5e"))
+    info = rep.info["boundary"]
+    assert info["dcn_generation"] == "v5e"
+    assert info["dcn_ms"] > 0 and info["ici_ms"] > 0
+    # the DCN leg dominates: slower wire, per-slice shards notwithstanding
+    assert info["dcn_ms"] > info["ici_ms"]
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: the static schedule-table lint
+# ---------------------------------------------------------------------------
+
+
+def test_lint_schedule_clean_tables():
+    from picotron_tpu.parallel.mpmd import SCHEDULES, build_schedule
+
+    # build_schedule lints at construction (raises ScheduleBufferError on
+    # failure) — a representative sweep must come back clean
+    for kind in SCHEDULES:
+        for pp in (2, 4, 8):
+            for n in (2, 8, 16):
+                for v in (1, 2) if kind == "interleaved" else (1,):
+                    build_schedule(kind, n, pp, v)
+
+
+def test_lint_schedule_catches_truncated_table():
+    from picotron_tpu.parallel.mpmd import build_schedule, lint_schedule
+
+    table = build_schedule("1f1b", 4, 4, 1)
+    truncated = [op for op in table if not (op.op == "B" and op.mb == 3)]
+    problems = lint_schedule(truncated, 4, 4, 1, kind="1f1b")
+    assert problems and any("never consumed" in p for p in problems)
+
+
+def test_lint_schedule_catches_missing_producer():
+    from picotron_tpu.parallel.mpmd import build_schedule, lint_schedule
+
+    table = build_schedule("1f1b", 4, 4, 1)
+    dropped = [op for op in table
+               if not (op.op == "F" and op.mb == 2 and op.vstage == 1)]
+    problems = lint_schedule(dropped, 4, 4, 1, kind="1f1b")
+    assert problems and any("never produced" in p for p in problems)
+
+
+def test_lint_schedule_catches_unbounded_live_set():
+    from picotron_tpu.parallel.mpmd import build_schedule, lint_schedule
+
+    # a gpipe table (save-everything) presented as 1f1b blows the
+    # in-flight budget: backwards deferred past the pipeline depth
+    table = build_schedule("gpipe", 16, 4, 1)
+    problems = lint_schedule(table, 16, 4, 1, kind="1f1b")
+    assert any("in-flight budget" in p for p in problems)
+
+
+def test_build_schedule_raises_on_linted_table(monkeypatch):
+    import picotron_tpu.parallel.mpmd as mpmd
+
+    monkeypatch.setattr(mpmd, "lint_schedule",
+                        lambda *a, **k: ["planted problem"])
+    with pytest.raises(mpmd.ScheduleBufferError, match="static lint"):
+        mpmd.build_schedule("1f1b", 4, 2, 1)

@@ -569,7 +569,9 @@ def test_shardflow_runs_gate():
     root = os.path.join(os.path.dirname(__file__), "..")
     with open(os.path.join(os.path.dirname(__file__), "data",
                            "shardflow_baseline.json")) as f:
-        baseline = json.load(f)["configs"]
+        raw_baseline = json.load(f)
+    baseline = raw_baseline["configs"]
+    slice_baseline = raw_baseline["slice_presets"]
     cfgs = sorted(
         __import__("glob").glob(os.path.join(root, "runs", "*",
                                              "config.json")))
@@ -615,4 +617,35 @@ def test_shardflow_runs_gate():
                     and not var.get(entry, {}).get("proven")):
                 problems.append(f"{name}: {entry} no longer proven "
                                 f"compile-once")
+
+    # slice-boundary gate (analysis/boundary.py): the crossing presets
+    # must stay audited with every collective in a tier — zero
+    # violations, and at least the baseline's declared-boundary traffic
+    sargs = [sys.executable,
+             os.path.join(root, "tools", "shardcheck.py"),
+             "--checks", "spec,boundary", "--json"]
+    for name in sorted(slice_baseline):
+        sargs += ["--preset", name]
+    sres = subprocess.run(sargs, capture_output=True, text=True, env=env,
+                          timeout=540, cwd=root)
+    srows = [json.loads(line) for line in sres.stdout.strip().splitlines()]
+    assert len(srows) == len(slice_baseline), sres.stderr[-2000:]
+    for row in srows:
+        name = row["config"].split("preset:", 1)[-1]
+        base = slice_baseline[name]
+        if "fatal" in row:
+            problems.append(f"{name}: newly fatal — {row['fatal']}")
+            continue
+        bnd = row["info"].get("boundary", {})
+        if base["slices_audited"] and not bnd.get("audited"):
+            problems.append(f"{name}: slice audit no longer runs")
+            continue
+        if bnd.get("violating", 0) > base["violating"]:
+            problems.append(
+                f"{name}: {bnd['violating']} ICI-axis-over-DCN "
+                f"violation(s), baseline {base['violating']}")
+        if bnd.get("boundary", 0) < base["boundary_min"]:
+            problems.append(
+                f"{name}: only {bnd.get('boundary', 0)} declared "
+                f"boundary op(s), baseline floor {base['boundary_min']}")
     assert not problems, "\n".join(problems)

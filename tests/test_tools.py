@@ -404,6 +404,43 @@ def test_telemetry_report_comm_row(tmp_path, capsys):
     assert "comm" not in json.loads(capsys.readouterr().out)
 
 
+def test_telemetry_report_comm_row_without_sync_records(tmp_path, capsys):
+    """Regression: a stream with NO sync-phase records (an MPMD run, or a
+    telemetry.jsonl cut before the first optimizer step) must render the
+    comm row's measured side as 'n/a' — not crash, not print None."""
+    import json
+
+    events = [
+        {"ts": 1.0, "kind": "phase", "phase": "step", "step": 1,
+         "category": "compute", "secs": 0.5},
+        {"ts": 2.0, "kind": "phase", "phase": "step", "step": 2,
+         "category": "compute", "secs": 0.5},
+    ]
+    with open(tmp_path / "telemetry.jsonl", "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps({
+        "distributed": {"dp_size": 2, "tp_size": 2},
+        "model": {"name": "debug-tiny"},
+        "training": {"seq_length": 64, "micro_batch_size": 1,
+                     "gradient_accumulation_steps": 2},
+    }))
+
+    tr = load_tool("telemetry_report")
+    assert tr.main([str(tmp_path), "--config", str(cfg_path),
+                    "--json"]) == 0
+    comm = json.loads(capsys.readouterr().out)["comm"]
+    assert comm["measured_sync_p50_ms"] is None
+    assert "comm_drift_pct" not in comm
+    for flags in ([], ["--markdown"]):
+        assert tr.main([str(tmp_path), "--config", str(cfg_path),
+                        *flags]) == 0
+        out = capsys.readouterr().out
+        assert "measured sync p50 n/a" in out
+        assert "None ms" not in out
+
+
 def test_bench_fails_fast_without_tpu_backend():
     """The satellite: a down TPU tunnel must yield ONE actionable line
     ('no TPU backend reachable ... rerun with --cpu or fix the tunnel'),

@@ -68,6 +68,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "the next block (megatron strategy, pp=1, dense)")
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1: shard Adam moments over dp")
+    p.add_argument("--slices", type=int, default=None,
+                   help="multislice topology: the pod spans N TPU slices "
+                        "joined by DCN; train.py's slicecheck preflight "
+                        "then audits every collective against the cut "
+                        "(analysis/boundary.py)")
+    p.add_argument("--dcn-axes", default=None, metavar="AXES",
+                   help="comma-separated mesh axes allowed to cross the "
+                        "DCN boundary with --slices > 1 (subset of "
+                        "dp,pp; default dp,pp — pick with "
+                        "tools/layout_planner.py --slices)")
     # model
     p.add_argument("--model", default="HuggingFaceTB/SmolLM-1.7B")
     p.add_argument("--from-hf-config", default=None, metavar="CONFIG_JSON",
@@ -221,6 +231,8 @@ def create_single_config(args) -> str:
             **({"tp_strategy": args.tp_strategy} if args.tp_strategy else {}),
             **({"tp_mesh": args.tp_mesh} if args.tp_mesh else {}),
             **({"tp_sync": args.tp_sync} if args.tp_sync else {}),
+            **({"slices": args.slices} if args.slices else {}),
+            **({"dcn_axes": args.dcn_axes} if args.dcn_axes else {}),
         },
         "model": {
             "name": args.model, **preset, **model_overrides,

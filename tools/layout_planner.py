@@ -113,6 +113,14 @@ def main(argv=None) -> int:
                          "HBM capacity")
     ap.add_argument("--hbm-gib", type=float, default=None,
                     help="override the generation's per-chip HBM capacity")
+    ap.add_argument("--slices", type=int, default=None, metavar="N",
+                    help="multislice planning: after ranking, price the "
+                         "winner's layout split over N slices — one row "
+                         "per DCN-tolerant axis (dp/pp) that can absorb "
+                         "the slice count, with the intra-slice ICI and "
+                         "cross-slice DCN tiers of the hierarchical "
+                         "decomposition priced separately "
+                         "(analysis/planner.slice_plans)")
     ap.add_argument("--no-flags", action="store_true",
                     help="search only the 5 parallel axes (skip sp/zero1/"
                          "offload toggles)")
@@ -286,9 +294,18 @@ def main(argv=None) -> int:
               "or shrink the model/batch", file=sys.stderr)
         return 1
 
+    slice_rows = []
+    if args.slices and args.slices > 1:
+        from picotron_tpu.analysis.planner import slice_plans
+
+        slice_rows = slice_plans(winner.cfg, model, args.slices)
+
     if args.json:
         for p in points[:args.top]:
             print(json.dumps(p.as_dict()), flush=True)
+        if args.slices and args.slices > 1:
+            print(json.dumps({"slice_plans": slice_rows,
+                              "winner": winner.label}), flush=True)
     else:
         n_all = len(points)
         print(f"layout planner: {base.model.name} seq "
@@ -302,6 +319,29 @@ def main(argv=None) -> int:
               f"{winner.cost.as_dict()['tokens_per_sec_per_chip']} "
               f"tok/s/chip)")
         print(f"  run it: {winner.overrides_line()}")
+        if args.slices and args.slices > 1:
+            print()
+            if not slice_rows:
+                print(f"slice planning: no DCN-tolerant axis of "
+                      f"{winner.label} can absorb {args.slices} slices "
+                      f"(dp and pp must be divisible by the slice count)")
+            else:
+                print(f"slice planning: {winner.label} over "
+                      f"{args.slices} slices "
+                      f"[{slice_rows[0]['generation']}]:")
+                hdr = ("axis", "crossing_terms", "dcn_bytes", "dcn_ms",
+                       "ici_ms", "total_comm_ms")
+                print("  " + "  ".join(h.rjust(14) for h in hdr))
+                for r in slice_rows:
+                    cells = (r["axis"],
+                             ",".join(r["crossing_terms"]) or "-",
+                             r["dcn_bytes"], r["dcn_ms"], r["ici_ms"],
+                             r["total_comm_ms"])
+                    print("  " + "  ".join(str(c).rjust(14)
+                                           for c in cells))
+                best_ax = slice_rows[0]["axis"]
+                print(f"  declare it: --override distributed.slices="
+                      f"{args.slices} distributed.dcn_axes={best_ax}")
     return 0
 
 

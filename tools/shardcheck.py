@@ -20,6 +20,11 @@ configs by abstract evaluation on simulated host devices:
 - jit-variant prover (--variants): statically enumerate the abstract
   signatures (shape/dtype/sharding/commitment) reaching each jit entry
   point — train step, serve prefill/decode — and prove compile-once
+- slice-boundary audit (--slices N, "slicecheck"): map every lowered
+  replica group onto the declared multislice partition and classify it
+  intra-slice / boundary / VIOLATING — an ICI-only axis (tp/cp/ep)
+  straddling the DCN cut is a named error, and the per-tier byte totals
+  are priced by the cost model's dcn tier under --cost
 - source lint: no semi-private jax.core, no host callbacks in library
   code, no uncommitted jax.device_put
 
@@ -29,6 +34,7 @@ Usage:
   python tools/shardcheck.py --preset tiny-dense --preset tiny-moe-ep
   python tools/shardcheck.py --all-presets --verbose
   python tools/shardcheck.py --all-presets --provenance --variants --json
+  python tools/shardcheck.py --preset tiny-dense --slices 2 --dcn-axes dp
 
 --json emits one machine-readable line per config for every subcommand
 (findings + the per-check info dict); a config that cannot trace at all
@@ -127,6 +133,21 @@ PRESETS: dict[str, tuple] = {
                   dict(gradient_accumulation_steps=2),
                   {},
                   dict(num_key_value_heads=4)),
+    # slice-boundary audit (analysis/boundary.py): the 8 simulated hosts
+    # split into 2 declared "slices"; with dp crossing the cut, every
+    # grad all-reduce must classify as a declared boundary crossing and
+    # every tp/cp collective must stay intra-slice — zero violations
+    "tiny-dense-dp-cross": ("debug-tiny",
+                            dict(dp_size=2, tp_size=2, cp_size=2,
+                                 slices=2, dcn_axes="dp"),
+                            dict(gradient_accumulation_steps=2)),
+    # same audit with the PIPELINE axis over DCN on the MPMD substrate:
+    # stage-boundary ppermutes are the only declared crossers
+    "tiny-pp-mpmd-cross": ("debug-tiny",
+                           dict(pp_size=2, tp_size=2,
+                                slices=2, dcn_axes="pp"),
+                           dict(gradient_accumulation_steps=2),
+                           dict(executor="mpmd")),
 }
 
 
@@ -164,8 +185,8 @@ def main(argv=None) -> int:
                          "ep>1, offload on/off)")
     ap.add_argument("--checks", default=None,
                     help="comma-separated subset of spec,source,"
-                         "collectives,provenance,variants,donation,"
-                         "stability (default: all)")
+                         "collectives,boundary,provenance,variants,"
+                         "donation,stability (default: all)")
     ap.add_argument("--provenance", action="store_true",
                     help="focus on the sharding-dataflow audit: collective "
                          "provenance, intended-vs-implicit classification, "
@@ -175,6 +196,15 @@ def main(argv=None) -> int:
                     help="focus on the static jit-variant prover: abstract "
                          "signatures reaching each jit entry point, "
                          "compile-once proof (spec lint still runs first)")
+    ap.add_argument("--slices", type=int, default=None,
+                    help="audit the collective schedule against an "
+                         "N-slice multislice partition (overrides the "
+                         "config's distributed.slices); a config "
+                         "declaring slices > 1 is audited automatically")
+    ap.add_argument("--dcn-axes", default=None,
+                    help="comma-separated mesh axes allowed to cross the "
+                         "DCN cut (subset of dp,pp; overrides the "
+                         "config's distributed.dcn_axes)")
     ap.add_argument("--budget-mb", type=float, default=None,
                     help="all-gather replication budget in MiB (default: "
                          "the largest param leaf / activation block)")
@@ -208,8 +238,11 @@ def main(argv=None) -> int:
         checks = ("spec",)
         checks += ("provenance",) if args.provenance else ()
         checks += ("variants",) if args.variants else ()
+        checks += ("boundary",) if args.slices else ()
     else:
         checks = ALL_CHECKS
+    if args.slices and "boundary" not in checks:
+        checks += ("boundary",)
     unknown = set(checks) - set(ALL_CHECKS)
     if unknown:
         ap.error(f"unknown checks {sorted(unknown)}; valid: {ALL_CHECKS}")
@@ -241,7 +274,8 @@ def main(argv=None) -> int:
     for label, cfg in targets:
         try:
             rep = run_shardcheck(cfg, checks=checks, budget_bytes=budget,
-                                 cost_model=cost_model)
+                                 cost_model=cost_model, slices=args.slices,
+                                 dcn_axes=args.dcn_axes)
         except Exception as e:  # layouts this JAX cannot trace (pre-vma)
             n_bad += 1
             if args.json:
@@ -298,6 +332,22 @@ def main(argv=None) -> int:
                         print(f"  {src}: {row['ops']} "
                               f"{'/'.join(row['kinds'])} <- {roots}",
                               flush=True)
+            bnd = rep.info.get("boundary")
+            if bnd and bnd.get("audited"):
+                from picotron_tpu.analysis.boundary import render_table
+
+                line = (f"boundary: {bnd['slices']} slice(s), dcn axes "
+                        f"[{bnd.get('dcn_axes', '')}] — "
+                        f"{bnd.get('intra', 0)} intra / "
+                        f"{bnd.get('boundary', 0)} boundary / "
+                        f"{bnd.get('violating', 0)} violating")
+                if "dcn_ms" in bnd:
+                    line += (f"; dcn {bnd['dcn_ms']:.3f} ms, intra-slice "
+                             f"ici {bnd['ici_ms']:.3f} ms "
+                             f"[{bnd['dcn_generation']}]")
+                print(line, flush=True)
+                if args.verbose:
+                    print(render_table(bnd), flush=True)
             var = rep.info.get("variants")
             if var:
                 for entry in ("train_step", "mpmd_stages", "serve"):
@@ -311,6 +361,14 @@ def main(argv=None) -> int:
                                   f"signature(s)")
                         print(f"variants[{v.get('entry', entry)}]: {state} "
                               f"({detail})", flush=True)
+                lint = (var.get("mpmd_stages") or {}).get("schedule_lint")
+                if lint:
+                    state = ("statically proven"
+                             if lint["proven"] else "FAILS the lint")
+                    print(f"variants[schedule:{lint['kind']}]: table "
+                          f"{state} ({lint['ops']} op(s) over "
+                          f"{lint['ticks']} tick(s), "
+                          f"{lint['problems']} problem(s))", flush=True)
             if cost_row:
                 line = (f"cost[{cost_row['generation']}]: predicted step "
                         f"{cost_row['predicted_step_ms']} ms (exposed "

@@ -353,10 +353,15 @@ def render(s: dict, markdown: bool = False) -> str:
     cm = s.get("comm")
     if cm:
         drift = cm.get("comm_drift_pct")
+        # a stream without sync-phase records (e.g. an MPMD run, or a
+        # telemetry.jsonl cut before the first optimizer step) has no
+        # measured side — render n/a, never a bare None
+        sync_p50 = cm.get("measured_sync_p50_ms")
+        sync_txt = f"{sync_p50} ms" if sync_p50 is not None else "n/a"
         msg = (f"comm [{cm['generation']}]: predicted "
                f"{cm['predicted_comm_ms']} ms/step exposed "
                f"(of {cm['predicted_step_ms']} ms predicted step) | "
-               f"measured sync p50 {cm['measured_sync_p50_ms']} ms"
+               f"measured sync p50 {sync_txt}"
                + (f" | drift {drift:+.1f}%" if drift is not None else ""))
         lines.append(f"**{msg}**" if markdown else msg)
         if cm.get("predicted_tp_comm_exposed_ms") or \
