@@ -27,12 +27,14 @@ classifies each collective:
   op cannot be proven either way (warning, never silently green).
 
 Two hierarchical presence rules ride along (mutation-tested like every
-schedule rule in collectives.py): a zero1 layout whose dp crosses DCN
-must keep its grad reduce-scatter (the intra-slice leg of the
-hierarchical decomposition rides it), and every boundary group must
-split into equal per-slice cohorts — an unequal split means the DCN leg
-widened past the small shard-per-slice transfer the decomposition
-promises.
+schedule rule in collectives.py): every crossing reduction must spell
+the hierarchical decomposition — either fused (one wide op keeping full
+per-slice cohorts, XLA decomposing internally) or explicit
+(cohort-1 DCN groups riding an intra-slice reduce-scatter leg, the
+runtime schedule parallel/hier_reduce.py emits) — and every boundary
+group must split into equal per-slice cohorts; an unequal split means
+the DCN leg widened past the small shard-per-slice transfer the
+decomposition promises.
 
 Per-tier byte totals use the standard hierarchical algorithm: a crossing
 collective of group size n over s slices (cohort m = n/s) does its wide
@@ -273,7 +275,9 @@ def audit_boundary(cfg, *, text: str = None, low=None, state=None,
         text = low.text
     from picotron_tpu.analysis.collectives import parse_collectives
 
-    classified = classify_ops(parse_collectives(text), topo)
+    ops = parse_collectives(text)
+    classified = classify_ops(ops, topo)
+    op_bytes = {op.line: op.nbytes for op in ops}
     counts = {c: sum(1 for r in classified if r.cls == c) for c in CLASSES}
     info = {
         "slices": topo.n_slices,
@@ -316,10 +320,20 @@ def audit_boundary(cfg, *, text: str = None, low=None, state=None,
     # The hierarchical decomposition of a crossing collective is:
     # reduce-scatter inside the slice (over the group's intra-slice
     # cohort) + a small shard-per-slice all-reduce across DCN + all-gather
-    # inside the slice. Both legs are statically checkable from the group
-    # structure: the intra leg exists iff crossing groups keep a
-    # non-trivial per-slice cohort, and the DCN leg stays small iff the
-    # cohorts are equal. tests/test_boundary.py mutates each away.
+    # inside the slice. EVERY crossing reduction must exhibit it in one of
+    # its two valid spellings, per op (an any-pass rule would let the flat
+    # scalar-loss psums mask a grad path whose intra leg was deleted):
+    #
+    # - fused form — one wide op whose groups keep full per-slice cohorts
+    #   (min cohort >= m_expected): XLA's own hierarchical lowering of a
+    #   flat psum on a hybrid mesh does the decomposition internally;
+    # - explicit form — cohort-1 groups (one member per slice: the
+    #   runtime schedule parallel/hier_reduce.py emits), valid only when
+    #   the intra-slice reduce-scatter leg is present in the text AND
+    #   (when result sizes parse) some intra scatter produced exactly
+    #   this op's shard — the "one shard per slice" size claim.
+    #
+    # tests/test_boundary.py mutates each leg away on both spellings.
     boundary_ops = [r for r in classified if r.cls == "boundary"]
     if "dp" in topo.declared and "dp" in topo.cut_axes:
         g_dp = topo.dcn_shape[AXES.index("dp")]
@@ -327,15 +341,30 @@ def audit_boundary(cfg, *, text: str = None, low=None, state=None,
         grad_crossers = [r for r in boundary_ops
                          if r.kind in ("all_reduce", "reduce_scatter")
                          and r.cohorts]
-        if m_expected > 1 and grad_crossers and not any(
-                min(r.cohorts) >= m_expected for r in grad_crossers):
-            rep.add(CHECK, ERROR, "hier_intra_scatter",
-                    f"dp crosses DCN but no crossing reduction keeps an "
-                    f"intra-slice cohort of {m_expected} (the per-slice "
-                    f"width of the fused data axes): the hierarchical "
-                    f"decomposition's intra-slice reduce-scatter leg is "
-                    f"missing — full-width gradients would cross DCN "
-                    f"instead of one shard per slice")
+        intra_rs = [r for r in classified
+                    if r.cls == "intra" and r.kind == "reduce_scatter"]
+        intra_rs_bytes = {op_bytes.get(r.line) for r in intra_rs}
+        intra_rs_bytes.discard(None)
+        if m_expected > 1:
+            for r in grad_crossers:
+                if min(r.cohorts) >= m_expected:
+                    continue  # fused form
+                nb = op_bytes.get(r.line)
+                if (max(r.cohorts) == 1 and intra_rs
+                        and (nb is None or not intra_rs_bytes
+                             or nb in intra_rs_bytes)):
+                    continue  # explicit form
+                rep.add(
+                    CHECK, ERROR, "hier_intra_scatter",
+                    f"{r.kind}@L{r.line}: dp crosses DCN but this "
+                    f"crossing reduction (cohorts "
+                    f"{'|'.join(str(c) for c in r.cohorts)}) neither "
+                    f"keeps an intra-slice cohort of {m_expected} (the "
+                    f"per-slice width of the fused data axes) nor rides "
+                    f"a matching intra-slice reduce-scatter leg: the "
+                    f"hierarchical decomposition's intra-slice "
+                    f"reduce-scatter is missing — full-width gradients "
+                    f"would cross DCN instead of one shard per slice")
     for r in boundary_ops:
         if r.cohorts and len(set(r.cohorts)) > 1:
             rep.add(CHECK, ERROR, "hier_dcn_cohort",

@@ -192,7 +192,7 @@ class CheckpointManager:
         d = self.cfg.distributed
         return {"dp": d.dp_size, "pp": d.pp_size, "ep": d.ep_size,
                 "cp": d.cp_size, "tp": d.tp_size,
-                "world_size": d.world_size,
+                "world_size": d.world_size, "slices": d.slices,
                 "process_count": jax.process_count()}
 
     def _commit(self, step: int, path: str) -> None:

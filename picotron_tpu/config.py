@@ -363,6 +363,14 @@ class DistributedConfig:
     # dcn tier); groups on any other axis that straddle it are
     # "violating" (a named preflight error).
     dcn_axes: str = "dp,pp"
+    # Runtime hierarchical dp gradient reduction across the slice cut:
+    # reduce-scatter inside the slice + a shard-per-slice all-reduce over
+    # DCN + intra-slice all-gather (parallel/hier_reduce.py), replacing the
+    # flat dp all-reduce the single-slice step emits. "auto" turns it on
+    # exactly when slices > 1 and dp physically carries a slice granule;
+    # "on" requires such a layout (refuses to silently no-op); "off" keeps
+    # the flat all-reduce (the A/B twin the parity tests pin against).
+    hier_dp_reduce: str = "auto"
     # Accepted for reference-JSON compatibility; ignored (XLA picks transport).
     backend: str = "jax"
     use_cpu: bool = False
@@ -434,6 +442,21 @@ class DistributedConfig:
                 raise ValueError(
                     "dcn_axes must declare at least one crossing axis "
                     "when slices > 1 (subset of 'dp,pp')")
+        if self.hier_dp_reduce not in ("auto", "on", "off"):
+            raise ValueError(
+                f"hier_dp_reduce must be 'auto', 'on' or 'off', got "
+                f"{self.hier_dp_reduce!r}")
+        if self.hier_dp_reduce == "on":
+            import math
+
+            if (self.slices <= 1 or "dp" not in axes
+                    or math.gcd(self.dp_size, self.slices) <= 1):
+                raise ValueError(
+                    "hier_dp_reduce='on' requires a multi-slice layout "
+                    "whose dp axis physically carries a slice granule "
+                    "(slices > 1, 'dp' in dcn_axes, gcd(dp_size, slices) "
+                    "> 1); use 'auto' to enable it only when the layout "
+                    "calls for it")
 
 
 DCN_TOLERANT_AXES = ("dp", "pp")

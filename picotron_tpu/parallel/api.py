@@ -243,13 +243,24 @@ def _data_axes_psum(grads, cfg: Config):
     """Sum grads over the data axes. 'ep' is a data axis for every param
     EXCEPT the expert banks sharded over it — their per-device grads already
     integrate every peer's tokens via the dispatch all_to_all, so an ep psum
-    would multiply them by ep_size."""
+    would multiply them by ep_size.
+
+    This is the one seam BOTH grad engines exit through (the AD and fused
+    paths below, and the pp scan path) — so it is also where the multi-slice
+    layouts swap the flat psum for the hierarchical DCN schedule
+    (parallel/hier_reduce.py): reduce-scatter inside the slice, a
+    shard-per-slice all-reduce across DCN, all-gather back."""
+    from picotron_tpu.parallel.hier_reduce import hier_axes_psum, use_hier_dp
+
     specs = param_specs(cfg)
+    hier = use_hier_dp(cfg)
 
     def red(g, spec):
         flat = [a for part in spec if part is not None
                 for a in (part if isinstance(part, (tuple, list)) else (part,))]
         axes = ("dp", "cp") if "ep" in flat else ("dp", "ep", "cp")
+        if hier:
+            return hier_axes_psum(g, axes, cfg)
         return lax.psum(g, axes)
 
     return jax.tree.map(red, grads, specs, is_leaf=lambda x: isinstance(x, P))

@@ -43,6 +43,15 @@ Per-axis structure (the north-star layouts; VERDICT r5):
   directions around the flash backward kernel). RoPE for the ring is
   applied outside the ring exactly as in the forward wiring
   (parallel/api.py), with the rotation's transpose recovered by jax.vjp.
+- **Multi-slice / DCN**: the in-scan accumulator is a purely per-device
+  fp32 tree — no collective touches it until the engine seam
+  (api._data_axes_psum) reduces it ONCE over the data axes after the last
+  microbatch. That single exit point is exactly where multi-slice layouts
+  swap the flat dp all-reduce for the hierarchical DCN schedule
+  (parallel/hier_reduce.py: intra-slice reduce-scatter, shard-per-slice
+  all-reduce over DCN, intra-slice all-gather), so the fused engine emits
+  the same slice-boundary schedule as the AD engine by construction —
+  pinned by the `tiny-dp-cross-fused` shardcheck preset's boundary audit.
 - **MoE (Mixtral expert block)**: the expert MLP is recomputed in backward
   by a segment VJP over `_moe_block` — routing (router logits, top-k,
   slot cumsum) recomputes deterministically from the saved layer input,

@@ -1,0 +1,131 @@
+"""Runtime hierarchical dp gradient reduction across the slice cut.
+
+Multi-slice jobs join TPU slices over DCN — a network orders of magnitude
+slower than ICI. PR 16's static auditor (analysis/boundary.py) classifies
+the traced schedule against the cut and its presence rules DEMAND the
+hierarchical decomposition of every crossing reduction; this module is the
+runtime half that actually emits it. A flat `psum(g, data_axes)` whose dp
+axis carries a slice granule becomes:
+
+    reduce-scatter over the intra-slice data axes (ep/cp, then the
+      per-slice dp factor)                    — wide legs, pure ICI
+    all-reduce over the dp slice granule      — one shard per slice, DCN
+    all-gather back in reverse order          — wide legs, pure ICI
+
+so the DCN link carries 1/m of the gradient bytes (m = the per-slice
+width of the fused data axes) instead of the full tree — the standard
+hierarchical algorithm the cost model prices (`CostModel.dcn_secs`) and
+the MPMD-pipeline paper (arxiv 2412.14374) assumes between slices.
+
+XLA *can* discover this decomposition itself on real hybrid meshes, but
+nothing guarantees it; emitting it explicitly makes the schedule the
+auditor's `hier_intra_scatter`/`hier_dcn_cohort` rules check a property
+of the program, not of a compiler mood. Numerics: identical sums in a
+different association order — bit-exact on integer-valued grads, ~1e-7
+relative on float ones (the documented tolerance the parity twin in
+tests/test_boundary.py pins).
+
+Group math mirrors mesh._split_axes_over_dcn: the slice granule g_dp is
+the OUTER factor of dp, so dp index = outer * inner + i with
+inner = dp_size // g_dp. Intra-slice dp cohorts are the contiguous
+runs [o*inner, (o+1)*inner); the DCN leg pairs equal inner offsets
+across granules (one member per slice — the cohort-1 groups the
+boundary auditor classifies as the declared DCN traffic).
+
+Both grad engines exit through this module: the AD and fused engines via
+api._data_axes_psum, the MPMD stage programs via mpmd._sub_data_psum.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax import lax
+
+from picotron_tpu import compat
+from picotron_tpu.config import Config, parse_dcn_axes
+
+
+def dp_granule(cfg: Config) -> tuple[int, int]:
+    """(g_dp, inner): the slice granule dp carries under the house rule
+    and the per-slice dp width (dp_size == g_dp * inner)."""
+    d = cfg.distributed
+    if d.slices <= 1:
+        return 1, d.dp_size
+    from picotron_tpu.mesh import _split_axes_over_dcn
+
+    grid = (d.dp_size, d.pp_size, d.ep_size, d.cp_size, d.tp_size)
+    dcn_shape, per_slice = _split_axes_over_dcn(grid, d.slices)
+    return dcn_shape[0], per_slice[0]
+
+
+def use_hier_dp(cfg: Config) -> bool:
+    """Resolve distributed.hier_dp_reduce: hierarchical iff the knob
+    allows it AND dp both is declared DCN-tolerant and physically
+    carries a slice granule ('auto' and 'on' agree here — 'on' merely
+    refuses at config validation when the layout cannot qualify)."""
+    d = cfg.distributed
+    if d.hier_dp_reduce == "off" or d.slices <= 1:
+        return False
+    if "dp" not in parse_dcn_axes(d.dcn_axes):
+        return False
+    g_dp, _ = dp_granule(cfg)
+    return g_dp > 1
+
+
+def _dp_groups(g_dp: int, inner: int) -> tuple[list, list]:
+    """(intra-slice, cross-slice) axis_index_groups over the dp axis."""
+    intra = [[o * inner + i for i in range(inner)] for o in range(g_dp)]
+    cross = [[o * inner + i for o in range(g_dp)] for i in range(inner)]
+    return intra, cross
+
+
+def _varying(x, axis: str):
+    """Re-mark `x` varying over `axis` after a grouped collective (which
+    the vma type system treats as axis-invariant even though groups
+    narrower than the axis leave values group-dependent) — the same
+    re-marking discipline as parallel/tp_strategies.py."""
+    if axis in compat.vma(x):
+        return x
+    return compat.pcast(x, (axis,), to="varying")
+
+
+def hier_axes_psum(x, axes: tuple, cfg: Config):
+    """`lax.psum(x, axes)` (with "dp" in `axes`) emitted as the
+    hierarchical schedule described in the module docstring. Exact
+    same sum, association order aside."""
+    d = cfg.distributed
+    g_dp, inner = dp_granule(cfg)
+    sizes = {"dp": d.dp_size, "ep": d.ep_size, "cp": d.cp_size}
+    intra_axes = [a for a in axes if a != "dp" and sizes[a] > 1]
+    m = inner * math.prod(sizes[a] for a in intra_axes)
+    if m <= 1:
+        # no intra-slice width to scatter over: the flat psum IS the
+        # shard-per-slice DCN leg (and the auditor's m_expected == 1
+        # skips the presence rule accordingly)
+        return lax.psum(x, axes)
+    intra_dp, cross_dp = _dp_groups(g_dp, inner)
+    shape, size = x.shape, x.size
+    v = x.reshape(-1)
+    pad = (-size) % m
+    if pad:
+        # zero padding is exact under summation; sliced back off below
+        v = jnp.pad(v, (0, pad))
+    for a in intra_axes:
+        # one collective per fused intra axis (<= 3), deliberate unroll
+        v = lax.psum_scatter(v, a, scatter_dimension=0, tiled=True)  # shardcheck: ok
+    if inner > 1:
+        v = _varying(
+            lax.psum_scatter(v, "dp", scatter_dimension=0, tiled=True,
+                             axis_index_groups=intra_dp), "dp")
+    v = lax.psum(v, "dp", axis_index_groups=cross_dp)
+    if inner > 1:
+        v = _varying(v, "dp")
+        v = lax.all_gather(v, "dp", axis=0, tiled=True,
+                           axis_index_groups=intra_dp)
+    for a in reversed(intra_axes):
+        v = lax.all_gather(v, a, axis=0, tiled=True)  # shardcheck: ok
+    if pad:
+        v = lax.slice_in_dim(v, 0, size)
+    return v.reshape(shape)

@@ -8,7 +8,13 @@ A chaos spec is a comma-separated list of events, each
   process at the start of step STEP — exercises the real preemption
   handler), ``kill`` (SIGKILL at the start of step STEP: a hard crash —
   no handler, no emergency checkpoint; exercises supervisor restart from
-  whatever is durable), ``hang`` (sleep SECS in the step loop at step
+  whatever is durable), ``slice_lost`` (SIGKILL at the start of step STEP,
+  logged as the loss of this process's slice: the whole-slice failure mode
+  of a multi-slice pod — every process of the lost slice dies at once and
+  the job cannot come back at the old shape; recovery is a supervised
+  restart into FEWER slices via ``tools/elastic_resize.py --slices`` or
+  ``checkpoint.elastic``, pinned e2e by ``tools/chaos.py --scenario
+  slice_lost``), ``hang`` (sleep SECS in the step loop at step
   STEP), ``ckpt_io`` (raise OSError from the next COUNT checkpoint-save
   attempts at step STEP — exercises the save retry), ``data_io`` (same
   for the next COUNT batch-assembly attempts at *batch* STEP),
@@ -61,9 +67,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-KINDS = ("sigterm", "sigint", "kill", "hang", "ckpt_io", "data_io",
-         "data_stall", "nan_grad", "ckpt_corrupt_bitflip", "ckpt_truncate",
-         "ckpt_torn_meta")
+KINDS = ("sigterm", "sigint", "kill", "slice_lost", "hang", "ckpt_io",
+         "data_io", "data_stall", "nan_grad", "ckpt_corrupt_bitflip",
+         "ckpt_truncate", "ckpt_torn_meta")
 
 # Which event kinds an injection point can trigger. "nan_grad" has no fire
 # point: the driver reads nan_grad_steps() and routes those steps through
@@ -72,7 +78,10 @@ KINDS = ("sigterm", "sigint", "kill", "hang", "ckpt_io", "data_io",
 # commit (manifest written, process 0) with the step dir as context — the
 # corruption kinds mutate a checkpoint the store considers good.
 _POINT_KINDS = {
-    "step_begin": ("sigterm", "sigint", "kill", "hang"),
+    # slice_lost is step_begin-only: a slice dies between steps from the
+    # surviving scheduler's viewpoint; mid-schedule slice death is the
+    # #TICK kill (the MPMD walk cannot tell which process vanished)
+    "step_begin": ("sigterm", "sigint", "kill", "slice_lost", "hang"),
     # inside the MPMD schedule walk (parallel/mpmd._run_schedule), one
     # call per dispatched op with ctx (tick, stage, op, mb); only #TICK
     # events fire here
@@ -111,8 +120,8 @@ def parse_spec(spec: str) -> list[ChaosEvent]:
         m = _EVENT_RE.match(item)
         if not m:
             raise ValueError(
-                f"bad chaos event {item!r}: expected KIND@STEP[xCOUNT][~SECS]"
-                f" with KIND in {KINDS}")
+                f"bad chaos event {item!r}: expected "
+                f"KIND@STEP[xCOUNT][~SECS][#TICK] with KIND in {KINDS}")
         kind = m.group("kind")
         if kind not in KINDS:
             raise ValueError(
@@ -228,6 +237,23 @@ class ChaosController:
                         signal.SIGTERM if e.kind == "sigterm"
                         else signal.SIGINT)
             elif e.kind == "kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif e.kind == "slice_lost":
+                # whole-slice death: every process of the slice vanishes
+                # at once, ungracefully. Self-delivered SIGKILL per
+                # process (deterministic across the pod, like the signal
+                # kinds); the log names the slice this process sits on so
+                # a multi-host transcript reads as one slice going dark.
+                try:
+                    import jax
+                    proc = jax.process_index()
+                except Exception:
+                    proc = 0
+                _log(f"slice_lost: the slice hosting process {proc} is "
+                     f"gone (SIGKILL, no emergency checkpoint) — the job "
+                     f"cannot restart at this slice count; resize with "
+                     f"tools/elastic_resize.py --slices or "
+                     f"checkpoint.elastic=true")
                 os.kill(os.getpid(), signal.SIGKILL)
             elif e.kind in ("hang", "data_stall"):
                 time.sleep(e.secs)

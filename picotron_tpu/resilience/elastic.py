@@ -86,8 +86,12 @@ TOPOLOGY_AXES = ("dp", "pp", "ep", "cp", "tp")
 # surgery is needed. tp/cp/ep re-partition WEIGHT math (head splits,
 # expert placement, sequence shards) that neither the re-stamp tool nor
 # Orbax's reshard validates — a mismatch there must fail loudly even when
-# elastic is on, never proceed into an unsupported restore.
-SUPPORTED_ELASTIC_AXES = ("dp", "pp")
+# elastic is on, never proceed into an unsupported restore. slices: the
+# slice count is physical placement metadata, not an array sharding — a
+# restore into fewer slices (the slice-loss recovery path) is just a
+# dp/pp resize whose recorded slice count also changed, so it rides the
+# same machinery (tools/chaos.py --scenario slice_lost pins it e2e).
+SUPPORTED_ELASTIC_AXES = ("dp", "pp", "slices")
 
 
 def topology_from_distributed(dist) -> dict:
@@ -97,14 +101,22 @@ def topology_from_distributed(dist) -> dict:
            else lambda k, d=None: getattr(dist, k, d))
     topo = {ax: int(get(f"{ax}_size", 1) or 1) for ax in TOPOLOGY_AXES}
     topo["world_size"] = int(np.prod([topo[ax] for ax in TOPOLOGY_AXES]))
+    # physical slice count rides along (field name `slices`, not a mesh
+    # axis: it never enters world_size — slices partition the axes above)
+    topo["slices"] = int(get("slices", 1) or 1)
     return topo
 
 
 def describe_topology(topo: Optional[dict]) -> str:
-    """Compact operator-facing rendering: 'dp2 pp1 ep1 cp1 tp2'."""
+    """Compact operator-facing rendering: 'dp2 pp1 ep1 cp1 tp2' — with a
+    'slices2' suffix only for multi-slice layouts (single-slice stays
+    byte-identical to the pre-slices rendering)."""
     if not topo:
         return "unknown"
-    return " ".join(f"{ax}{topo.get(ax, '?')}" for ax in TOPOLOGY_AXES)
+    s = " ".join(f"{ax}{topo.get(ax, '?')}" for ax in TOPOLOGY_AXES)
+    if int(topo.get("slices", 1) or 1) > 1:
+        s += f" slices{int(topo['slices'])}"
+    return s
 
 
 def saved_topology(step_dir: str) -> Optional[dict]:
@@ -139,9 +151,15 @@ def topology_mismatch(saved: Optional[dict],
     ([] when compatible or when either side recorded nothing)."""
     if not saved or not current:
         return []
-    return [ax for ax in TOPOLOGY_AXES
+    axes = [ax for ax in TOPOLOGY_AXES
             if saved.get(ax) is not None and current.get(ax) is not None
             and int(saved[ax]) != int(current[ax])]
+    # slice count compares with a default of 1: a pre-slices checkpoint
+    # recorded no field, which means single-slice — restoring it into a
+    # multi-slice mesh (or vice versa) IS a topology change
+    if int(saved.get("slices", 1) or 1) != int(current.get("slices", 1) or 1):
+        axes.append("slices")
+    return axes
 
 
 def resize_invocation(save_dir: str, step: int, current: dict,

@@ -87,6 +87,29 @@ def test_topology_mismatch_and_describe():
     assert elastic.topology_mismatch(None, a) == []  # nothing recorded
 
 
+def test_topology_records_and_compares_slices():
+    """The slice count rides the topology schema as placement metadata:
+    recorded by topology_from_distributed, rendered only when > 1 (the
+    single-slice string stays byte-identical to the pre-slices one),
+    compared with a default of 1 so pre-slices checkpoints read as
+    single-slice — and it never multiplies into world_size."""
+    multi = elastic.topology_from_distributed(
+        DistributedConfig(dp_size=2, tp_size=2, cp_size=2,
+                          slices=2, dcn_axes="dp"))
+    assert multi["slices"] == 2
+    assert multi["world_size"] == 8  # slices partition the axes, not x2
+    assert elastic.describe_topology(multi) == \
+        "dp2 pp1 ep1 cp2 tp2 slices2"
+    solo = elastic.topology_from_distributed(
+        DistributedConfig(dp_size=2, tp_size=2, cp_size=2))
+    assert "slices" not in elastic.describe_topology(solo)
+    assert elastic.topology_mismatch(multi, solo) == ["slices"]
+    # a pre-slices topology dict (no field at all) means single-slice
+    legacy = {ax: solo[ax] for ax in elastic.TOPOLOGY_AXES}
+    assert elastic.topology_mismatch(legacy, multi) == ["slices"]
+    assert elastic.topology_mismatch(legacy, solo) == []
+
+
 def test_saved_topology_meta_fallback(tmp_path):
     """Pre-manifest (legacy) step dirs fall back to meta.json's recorded
     config; a dir recording neither yields None (guard disengages)."""
@@ -276,9 +299,11 @@ def _fake_step(tmp_path, *, mbs=1, ga=1, **dist):
     enough for check_restore_topology, without a real Orbax store."""
     step = tmp_path / "saved" / "step_00000002"
     step.mkdir(parents=True)
+    dcfg = {f"{ax}_size": int(dist.get(f"{ax}_size", 1))
+            for ax in elastic.TOPOLOGY_AXES}
+    dcfg["slices"] = int(dist.get("slices", 1))
     meta = {"config": {
-        "distributed": {f"{ax}_size": int(dist.get(f"{ax}_size", 1))
-                        for ax in elastic.TOPOLOGY_AXES},
+        "distributed": dcfg,
         "training": {"micro_batch_size": mbs,
                      "gradient_accumulation_steps": ga},
     }}
@@ -346,6 +371,32 @@ def test_restore_topology_rejects_unsupported_axis_even_elastic(
         elastic.check_restore_topology(
             step_dir, meta, cfg, step=2, save_dir=str(tmp_path / "saved"))
     assert axis in str(exc.value)
+
+
+def test_restore_topology_slices_mismatch_names_both(tmp_path):
+    """Satellite pin: restoring a 2-slice checkpoint into a single-slice
+    (and dp-shrunk) mesh with elastic OFF fails naming BOTH topologies —
+    slices included — and quotes a re-stamp line carrying --slices; with
+    elastic on, the resize record lists slices among the changed axes
+    (the slice-loss recovery allow-path)."""
+    step_dir, meta = _fake_step(tmp_path, mbs=1, ga=1, dp_size=2,
+                                slices=2)                        # gbs 2
+    cfg_off = make_cfg(tmp_path, dp_size=1, mbs=1, ga=2)         # gbs 2
+    with pytest.raises(RuntimeError) as exc:
+        elastic.check_restore_topology(
+            step_dir, meta, cfg_off, step=2,
+            save_dir=str(tmp_path / "saved"))
+    msg = str(exc.value)
+    assert "slices2" in msg                      # the saved topology
+    assert "dp1 pp1 ep1 cp1 tp1" in msg          # the current one
+    assert "dp, slices" in msg                   # mismatched axes named
+    assert "--dp 1" in msg and "--slices 1" in msg
+
+    cfg_on = make_cfg(tmp_path, dp_size=1, mbs=1, ga=2, elastic_on=True)
+    rec = elastic.check_restore_topology(
+        step_dir, meta, cfg_on, step=2, save_dir=str(tmp_path / "saved"))
+    assert rec["axes"] == ["dp", "slices"]
+    assert rec["from"]["slices"] == 2 and rec["to"]["slices"] == 1
 
 
 def test_resize_invocation_renders_mismatched_axes():
@@ -422,6 +473,43 @@ def test_elastic_resize_tool_restamps_joint_dp_pp(tmp_path):
     assert topo["dp"] == 1 and topo["pp"] == 1 and topo["world_size"] == 1
 
 
+def test_elastic_resize_tool_restamps_slices(tmp_path):
+    """--slices on the offline tool (the slice-loss recovery re-stamp):
+    a 2-slice store records slices=2 in its manifest topology; a target
+    count the resumed config would refuse (slices > dp*pp) is rejected
+    with the store untouched; --slices 1 re-stamps it single-slice as
+    pure placement metadata — dp and the batch plan untouched — and the
+    step re-verifies."""
+    cfg_a = make_cfg(tmp_path, dp_size=2, tp_size=2, mbs=2, ga=1,
+                     slices=2, dcn_axes="dp")
+    _save_step(cfg_a)
+    save_dir = cfg_a.checkpoint.save_dir
+    [step_dir] = [os.path.join(save_dir, d) for d in os.listdir(save_dir)
+                  if d.startswith("step_")]
+    # satellite pin: the manifest topology records the slice count
+    topo = elastic.saved_topology(step_dir)
+    assert topo["slices"] == 2
+    assert elastic.describe_topology(topo).endswith("slices2")
+
+    tool = _load_tool()
+    before = open(os.path.join(step_dir, "meta.json")).read()
+    assert tool.main([save_dir, "--slices", "3"]) == 1  # 3 ∤ dp*pp = 2
+    assert open(os.path.join(step_dir, "meta.json")).read() == before
+
+    assert tool.main([save_dir, "--slices", "1"]) == 0
+    meta = json.load(open(os.path.join(step_dir, "meta.json")))
+    assert meta["config"]["distributed"]["slices"] == 1
+    assert meta["config"]["distributed"]["dp_size"] == 2   # untouched
+    assert meta["config"]["training"]["micro_batch_size"] == 2
+    assert meta["config"]["training"]["gradient_accumulation_steps"] == 1
+    assert meta["elastic_restamp"]["to"]["slices"] == 1
+    topo = elastic.saved_topology(step_dir)
+    assert topo.get("slices", 1) == 1 and topo["dp"] == 2
+
+    from picotron_tpu.ckpt_integrity import verify_step_dir
+    assert verify_step_dir(step_dir).status == "verified"
+
+
 # ---------------------------------------------------------------------------
 # ckpt_doctor source-topology column
 # ---------------------------------------------------------------------------
@@ -430,7 +518,8 @@ def test_elastic_resize_tool_restamps_joint_dp_pp(tmp_path):
 def test_ckpt_doctor_reports_source_topology(tmp_path, capsys):
     import importlib.util
 
-    cfg = make_cfg(tmp_path, dp_size=2, tp_size=2)
+    cfg = make_cfg(tmp_path, dp_size=2, tp_size=2, slices=2,
+                   dcn_axes="dp")
     _save_step(cfg)
     spec = importlib.util.spec_from_file_location(
         "ckpt_doctor_topo", os.path.join(os.path.dirname(__file__), "..",
@@ -442,11 +531,13 @@ def test_ckpt_doctor_reports_source_topology(tmp_path, capsys):
     rows = doctor.scan(cfg.checkpoint.save_dir)
     assert rows[0]["topology"]["dp"] == 2
     assert rows[0]["topology"]["tp"] == 2
+    assert rows[0]["topology"]["slices"] == 2
 
     assert doctor.main([cfg.checkpoint.save_dir, "--markdown"]) == 0
     md = capsys.readouterr().out
-    assert "dp2 pp1 ep1 cp1 tp2" in md
+    assert "dp2 pp1 ep1 cp1 tp2 slices2" in md
     assert "| step | verdict | topology |" in md
     assert doctor.main([cfg.checkpoint.save_dir, "--json"]) == 0
     out = json.loads(capsys.readouterr().out)
     assert out["steps"][0]["topology"]["dp"] == 2
+    assert out["steps"][0]["topology"]["slices"] == 2

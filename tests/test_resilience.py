@@ -58,10 +58,48 @@ def test_chaos_spec_parses_all_fields():
     "hang@2~0",       # zero-duration sleep
     "ckpt_io@2#1",    # #TICK on a kind with no schedule_tick meaning
     "nan_grad@3#2",   # ditto — poison is a step property, not an op one
+    "slice_lost@3#1",  # a slice dies between steps, never mid-schedule
 ])
 def test_chaos_spec_rejects_malformed(bad):
     with pytest.raises(ValueError):
         chaos.parse_spec(bad)
+
+
+def test_chaos_spec_errors_are_actionable():
+    """The two parse errors teach the full surface: a malformed event
+    names the complete KIND@STEP[xCOUNT][~SECS][#TICK] grammar, an
+    unknown kind enumerates every valid kind (slice_lost included) —
+    and the module docstring documents the grammar it parses."""
+    with pytest.raises(ValueError, match=r"\[xCOUNT\]\[~SECS\]\[#TICK\]"):
+        chaos.parse_spec("sigterm")
+    with pytest.raises(ValueError) as ei:
+        chaos.parse_spec("bogus@3")
+    for kind in chaos.KINDS:
+        assert kind in str(ei.value), kind
+    assert "slice_lost" in chaos.KINDS
+    assert "slice_lost" in chaos.__doc__
+    assert "slice_lost" in chaos._POINT_KINDS["step_begin"]
+    assert "slice_lost" not in chaos._TICK_KINDS
+
+
+def test_chaos_slice_lost_fires_sigkill_naming_the_slice(monkeypatch,
+                                                         capsys):
+    """slice_lost: SIGKILL at step_begin of the named step — per process,
+    like a whole slice going dark at once — with the lost slice named in
+    the log so a multi-host transcript is attributable."""
+    calls = []
+    monkeypatch.setattr(chaos.os, "kill",
+                        lambda pid, sig: calls.append((pid, sig)))
+    ctrl = chaos.ChaosController(chaos.parse_spec("slice_lost@3"))
+    ctrl.fire("step_begin", step=2)
+    assert not calls
+    ctrl.fire("step_begin", step=3)
+    assert calls == [(os.getpid(), signal.SIGKILL)]
+    ctrl.fire("step_begin", step=3)  # budget of 1: exhausted
+    assert len(calls) == 1
+    err = capsys.readouterr().err
+    assert "slice_lost: the slice hosting process" in err
+    assert "elastic_resize.py --slices" in err
 
 
 def test_chaos_spec_parses_tick_suffix():
@@ -576,7 +614,7 @@ def test_chaos_cli_lists_every_scenario(capsys):
     out = capsys.readouterr().out
     for name in ("sigterm", "ckpt_io", "nan_skip", "nan_rollback",
                  "data_stall", "ckpt_corrupt_bitflip", "dp_resize",
-                 "pp_resize", "mpmd_sigterm"):
+                 "pp_resize", "slice_lost", "mpmd_sigterm"):
         assert name in out
 
 
@@ -657,6 +695,35 @@ def test_chaos_dp_resize_scenario(tmp_path):
 
     cli = _load_chaos_cli()
     assert cli.run_dp_resize(str(tmp_path))
+
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report", os.path.join(os.path.dirname(__file__), "..",
+                                         "tools", "telemetry_report.py"))
+    rep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rep)
+    stream = os.path.join(tmp_path, "fault", "ckpt", "telemetry.jsonl")
+    s = rep.summarize(rep.load_events(stream))
+    assert s["steps"]["count"] == cli.STEPS
+    assert s["steps"]["max"] == cli.STEPS
+    assert s["steps"]["replayed"] == 0
+    assert s["categories"].get("resize", 0.0) > 0
+    assert s["resize"]["events"] >= 1
+
+
+@pytest.mark.slow
+def test_chaos_slice_lost_scenario(tmp_path):
+    """Whole-slice loss, the full multi-process scenario: a 2-slice run
+    with the hierarchical dp reduction live is killed by slice_lost@3,
+    the store is re-stamped single-slice offline (--slices 1), and the
+    surviving chips finish at dp=1 via checkpoint.elastic. run_slice_lost
+    itself asserts the slice-naming log line, the manifest slice counts
+    before/after the re-stamp, final step/tokens, per-step loss parity vs
+    the single-slice baseline, and the resize booking; here we pin zero
+    replay — losing a slice costs a resize, not ground."""
+    import importlib.util
+
+    cli = _load_chaos_cli()
+    assert cli.run_slice_lost(str(tmp_path))
 
     spec = importlib.util.spec_from_file_location(
         "telemetry_report", os.path.join(os.path.dirname(__file__), "..",

@@ -35,6 +35,14 @@ Scenarios (the runtime-failure matrix README "Fault tolerance" documents):
                 mesh via checkpoint.elastic — same loss-parity /
                 resize-booking bar as dp_resize, plus the PR-9 prover
                 pins every rebuilt stage program compiles exactly once
+  slice_lost    whole-slice loss on a 2-slice job running the
+                hierarchical dp gradient reduction: slice_lost@3 kills
+                the pod with the lost slice named in the log, the store
+                is re-stamped single-slice offline (tools/
+                elastic_resize.py --slices 1), and the surviving chips
+                finish at dp=1 via checkpoint.elastic — final
+                step/tokens and per-step losses match the single-slice
+                baseline, resize booked to the goodput ledger
   mpmd_sigterm  mid-schedule faults on the MPMD executor: SIGTERM at a
                 named (stage, tick, op) drains the schedule walk to the
                 step boundary (emergency ckpt, exit 75, zero replayed
@@ -635,6 +643,174 @@ def run_mpmd_sigterm(workdir: str, verbose: bool = False) -> bool:
     return True
 
 
+def run_slice_lost(workdir: str, verbose: bool = False) -> bool:
+    """Whole-slice loss on a 2-slice job — THE failure mode multi-slice
+    adds over a single pod. Custom runner (per-leg configs + an offline
+    CLI step), registered next to SCENARIOS:
+
+      baseline  dp=2 tp=2, single slice, fault-free, steps 1-6
+      leg 1     dp=2 tp=2 slices=2 dcn_axes=dp — the hierarchical dp
+                gradient reduction is live — slice_lost@3: SIGKILL with
+                the slice named in the log; the sync save @2 is durable
+                and records slices=2 in its manifest topology
+      re-stamp  tools/elastic_resize.py --slices 1 rewrites the store as
+                single-slice (placement metadata only; dp untouched)
+      leg 2     dp=1 tp=2 (one surviving slice's worth of chips) with
+                checkpoint.elastic=true: the dp 2->1 mismatch rides the
+                runtime resize path at constant global batch, is booked
+                to the `resize` goodput category, and trains to done
+
+    Final step/tokens and the per-step loss trajectory must match the
+    fault-free baseline — fp32 reduction order is the only legitimate
+    difference (the hierarchical schedule reassociates the dp sum; the
+    documented ~1e-7 band of parallel/hier_reduce.py sits far inside the
+    rtol=1e-3 house tolerance)."""
+    import numpy as np
+
+    from picotron_tpu.resilience import elastic
+
+    fail = lambda msg: (print(f"[chaos-cli] slice_lost: FAIL — {msg}"),  # noqa: E731
+                        False)[1]
+
+    def leg_config(ckpt_dir: str, *, dp: int, mbs: int, ga: int,
+                   slices: int = 1, chaos_spec: str = "",
+                   elastic_on: bool = False) -> dict:
+        cfg = scenario_config(os.path.dirname(ckpt_dir), chaos_spec,
+                              {"checkpoint": {"async_save": False}})
+        cfg["distributed"]["dp_size"] = dp
+        cfg["distributed"]["slices"] = slices
+        if slices > 1:
+            cfg["distributed"]["dcn_axes"] = "dp"
+        cfg["training"]["micro_batch_size"] = mbs
+        cfg["training"]["gradient_accumulation_steps"] = ga
+        cfg["checkpoint"]["save_dir"] = ckpt_dir
+        if elastic_on:
+            cfg["checkpoint"]["elastic"] = True
+        return cfg
+
+    def run_leg(cfg: dict, cfg_name: str, leg_dir: str) -> int:
+        cfg_path = os.path.join(leg_dir, cfg_name)
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+        return _run_trainer(cfg_path, os.path.join(leg_dir, "run.log"), {})
+
+    def step_losses(jsonl_path: str) -> dict:
+        losses = {}
+        with open(jsonl_path) as f:
+            for line in f:
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn final line of a killed leg
+                if ev.get("kind") == "step" and "loss" in ev:
+                    losses[ev["step"]] = ev["loss"]  # last wins (replay)
+        return losses
+
+    def newest_step_dir(ckpt_dir: str) -> str:
+        steps = sorted(
+            int(m.group(1)) for d in os.listdir(ckpt_dir)
+            if (m := re.fullmatch(r"step_(\d+)", d))
+            and os.path.isdir(os.path.join(ckpt_dir, d, "state")))
+        return os.path.join(ckpt_dir, f"step_{steps[-1]:08d}")
+
+    # Fault-free single-slice baseline: the trajectory to stay on.
+    base_dir = os.path.join(workdir, "baseline")
+    os.makedirs(base_dir, exist_ok=True)
+    base_ckpt = os.path.join(base_dir, "ckpt")
+    rc = run_leg(leg_config(base_ckpt, dp=2, mbs=2, ga=1),
+                 "config.json", base_dir)
+    if rc != 0:
+        return fail(f"baseline run exited {rc}")
+    base_meta = _final_meta(base_ckpt)
+
+    fault_dir = os.path.join(workdir, "fault")
+    os.makedirs(fault_dir, exist_ok=True)
+    ckpt_dir = os.path.join(fault_dir, "ckpt")
+
+    # Leg 1: 2-slice run with the hierarchical dp reduction live, a
+    # whole slice lost at step-3 begin; the sync save @2 is durable.
+    rc = run_leg(leg_config(ckpt_dir, dp=2, mbs=2, ga=1, slices=2,
+                            chaos_spec=f"slice_lost@{STEPS // 2}"),
+                 "config_slices2.json", fault_dir)
+    if rc != -signal.SIGKILL:
+        return fail(f"leg 1 (slices=2) exited {rc}, expected "
+                    f"{-signal.SIGKILL} (SIGKILL)")
+    with open(os.path.join(fault_dir, "run.log")) as f:
+        leg1_log = f.read()
+    if "slice_lost: the slice hosting process" not in leg1_log:
+        return fail("slice_lost firing (with the lost slice named) "
+                    "absent from the leg-1 log")
+    saved = elastic.saved_topology(newest_step_dir(ckpt_dir)) or {}
+    if saved.get("slices") != 2:
+        return fail(f"durable save records topology {saved}, expected "
+                    f"slices=2 in its manifest")
+
+    # Offline re-stamp: single-slice store (the survivors' shape).
+    resize_log = os.path.join(fault_dir, "resize.log")
+    with open(resize_log, "ab") as log:
+        rc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "elastic_resize.py"),
+             ckpt_dir, "--slices", "1"],
+            stdout=log, stderr=subprocess.STDOUT, timeout=120).returncode
+    if rc != 0:
+        return fail(f"tools/elastic_resize.py --slices 1 exited {rc} "
+                    f"(see {resize_log})")
+    saved = elastic.saved_topology(newest_step_dir(ckpt_dir)) or {}
+    if saved.get("slices", 1) != 1:
+        return fail(f"re-stamped store still records {saved}")
+
+    # Leg 2: one slice's worth of chips (dp=1), checkpoint.elastic — the
+    # dp 2->1 mismatch reshards at restore time, booked as `resize`.
+    rc = run_leg(leg_config(ckpt_dir, dp=1, mbs=2, ga=2, elastic_on=True),
+                 "config_dp1.json", fault_dir)
+    if rc != 0:
+        return fail(f"leg 2 (dp=1, elastic) exited {rc}, expected 0")
+
+    with open(os.path.join(fault_dir, "run.log")) as f:
+        log_text = f.read()
+    if verbose:
+        print(log_text)
+    if not re.search(r"elastic resize:", log_text):
+        return fail("marker /elastic resize:/ absent from the leg-2 log")
+
+    meta = _final_meta(ckpt_dir)
+    for key in ("step", "trained_tokens"):
+        if meta[key] != base_meta[key]:
+            return fail(f"final {key} {meta[key]} != fault-free baseline "
+                        f"{base_meta[key]}")
+
+    base_losses = step_losses(os.path.join(base_ckpt, "telemetry.jsonl"))
+    fault_losses = step_losses(os.path.join(ckpt_dir, "telemetry.jsonl"))
+    if set(fault_losses) != set(base_losses):
+        return fail(f"step sets differ: fault {sorted(fault_losses)} vs "
+                    f"baseline {sorted(base_losses)}")
+    steps = sorted(base_losses)
+    bl = np.array([base_losses[s] for s in steps])
+    fl = np.array([fault_losses[s] for s in steps])
+    if not np.allclose(fl, bl, rtol=1e-3, atol=1e-4):
+        return fail(f"loss trajectory diverged from baseline: "
+                    f"{list(zip(steps, fl.tolist(), bl.tolist()))}")
+
+    import telemetry_report
+
+    summary = telemetry_report.summarize(telemetry_report.load_events(
+        os.path.join(ckpt_dir, "telemetry.jsonl")))
+    if summary["categories"].get("resize", 0.0) <= 0.0:
+        return fail(f"no `resize` seconds in the goodput categories "
+                    f"({summary['categories']})")
+    if not summary.get("resize", {}).get("events"):
+        return fail("no elastic_resize event in the telemetry stream")
+
+    print(f"[chaos-cli] slice_lost: OK — 2-slice run lost a slice, "
+          f"re-stamped --slices 1, finished at dp=1 via runtime elastic; "
+          f"final step {meta['step']} / {meta['trained_tokens']} tokens "
+          f"and loss trajectory match baseline; resize booked "
+          f"{summary['categories']['resize']:.3f}s")
+    return True
+
+
 def _doctor_flags_exactly(save_dir: str, corrupt_step: int):
     """tools/ckpt_doctor.py over the faulted store must flag exactly the
     injected-corrupt step and pass the rest (the fsck half of the
@@ -784,6 +960,13 @@ CUSTOM_SCENARIOS: dict[str, tuple[Callable, str]] = {
                   "finish at pp=2 via checkpoint.elastic; loss parity "
                   "vs the pp=2 baseline, resize booked, rebuilt stage "
                   "programs proven compile-once"),
+    "slice_lost": (run_slice_lost,
+                   "whole-slice loss on a 2-slice job (hierarchical dp "
+                   "grads live): slice_lost@3 SIGKILLs with the slice "
+                   "named, tools/elastic_resize.py --slices 1 re-stamps "
+                   "the store, the survivors finish at dp=1 via "
+                   "checkpoint.elastic; loss parity vs the single-slice "
+                   "baseline, resize booked"),
     "mpmd_sigterm": (run_mpmd_sigterm,
                      "mid-schedule MPMD faults: SIGTERM at a named "
                      "(stage, tick, op) drains to the step boundary "

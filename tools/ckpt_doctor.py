@@ -28,10 +28,12 @@ GC applies the same retention policy the trainer's in-loop GC uses
 last verified step survives regardless of --keep-last.
 
 The topology column is the routing surface for elastic re-stamps: a step
-rewritten by `tools/elastic_resize.py` (dp and/or pp) reports its NEW
-topology here — the store simply is that shape afterwards — so "which pp
-does this checkpoint restore at" is answered by this table, not by the
-config that originally trained it.
+rewritten by `tools/elastic_resize.py` (dp, pp and/or slices) reports its
+NEW topology here — the store simply is that shape afterwards — so "which
+pp does this checkpoint restore at" is answered by this table, not by the
+config that originally trained it. Multi-slice checkpoints carry a
+`slicesN` suffix (and a `slices` field in --json): after a slice loss,
+the table shows which steps already restore at the surviving count.
 """
 
 from __future__ import annotations

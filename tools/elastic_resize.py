@@ -1,8 +1,9 @@
 #!/usr/bin/env python
-"""Offline elastic re-stamp: adapt a verified checkpoint to a new dp/pp.
+"""Offline elastic re-stamp: adapt a verified checkpoint to a new
+dp/pp/slice layout.
 
-`python tools/elastic_resize.py CKPT_DIR [--dp M] [--pp K] [--step N]
- [--dry-run]`  (at least one of --dp / --pp)
+`python tools/elastic_resize.py CKPT_DIR [--dp M] [--pp K] [--slices S]
+ [--step N] [--dry-run]`  (at least one of --dp / --pp / --slices)
 
 The restore path (picotron_tpu/checkpoint.py) refuses to resume a
 checkpoint into a mesh whose topology differs from the one it was saved
@@ -24,6 +25,15 @@ touching anything; an uneven split (saved or target) bakes its pp into
 the padded shape and is refused with the slot mismatch named. pp does
 not enter global_batch_size (= mbs x ga x dp x ep), so a pure-pp
 re-stamp leaves the batch plan untouched.
+
+A slice re-stamp (`--slices S`, the slice-loss recovery path: a
+multi-slice pod loses a slice and must come back at the surviving
+hardware's shape) is pure placement metadata — the slice count never
+enters an array sharding, it only partitions the mesh axes over DCN — so
+it rides the same meta.json + manifest rewrite, usually alongside the
+--dp/--pp change that shrinks the mesh onto the survivors. The target
+count must still divide dp*pp at the TARGET sizes (the config-validation
+rule), checked before anything is rewritten.
 
 Safety: the step is deep-verified against its commit manifest BEFORE
 anything is rewritten. Re-stamping rebuilds the manifest from the
@@ -63,8 +73,8 @@ def list_steps(save_dir: str) -> list[int]:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="re-stamp a checkpoint step for a new dp and/or pp "
-                    "size (constant global batch; even pp splits only)")
+        description="re-stamp a checkpoint step for a new dp/pp/slice "
+                    "layout (constant global batch; even pp splits only)")
     ap.add_argument("save_dir", help="checkpoint directory (the trainer's "
                     "checkpoint.save_dir, containing step_XXXXXXXX dirs)")
     ap.add_argument("--dp", type=int, default=None,
@@ -73,16 +83,25 @@ def main(argv=None) -> int:
                     help="target pipeline-parallel size (the saved and "
                          "target padded layer stacks must match — even "
                          "splits only)")
+    ap.add_argument("--slices", type=int, default=None,
+                    help="target slice count (slice-loss recovery: "
+                         "restart the surviving slices as a smaller "
+                         "multi-slice or single-slice job; placement "
+                         "metadata only — pair with --dp/--pp to shrink "
+                         "the mesh onto the survivors)")
     ap.add_argument("--step", type=int, default=None,
                     help="step to re-stamp (default: newest step that "
                          "passes verification)")
     ap.add_argument("--dry-run", action="store_true",
                     help="print the plan without touching the store")
     args = ap.parse_args(argv)
-    if args.dp is None and args.pp is None:
-        ap.error("pick a target topology: --dp M and/or --pp K")
+    if args.dp is None and args.pp is None and args.slices is None:
+        ap.error("pick a target topology: --dp M, --pp K and/or "
+                 "--slices S")
     if args.pp is not None and args.pp < 1:
         ap.error(f"--pp must be >= 1, got {args.pp}")
+    if args.slices is not None and args.slices < 1:
+        ap.error(f"--slices must be >= 1, got {args.slices}")
 
     steps = list_steps(args.save_dir)
     if not steps:
@@ -169,6 +188,21 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 1
 
+    slices_old = int(saved.get("slices", dist.get("slices", 1) or 1))
+    slices_new = args.slices if args.slices is not None else slices_old
+    if slices_new > 1:
+        # the config-validation rule at the TARGET sizes: the slice
+        # granule must be absorbable by dp*pp, or the resumed run would
+        # refuse its own config before restoring anything
+        if slices_new > plan.dp_new * pp_new or (
+                plan.dp_new * pp_new) % slices_new != 0:
+            print(f"cannot re-stamp step {step} to slices={slices_new}: "
+                  f"slices must divide dp*pp = {plan.dp_new * pp_new} "
+                  f"(dp={plan.dp_new}, pp={pp_new}) and not exceed it — "
+                  f"the resumed run's config validation would refuse "
+                  f"this layout", file=sys.stderr)
+            return 1
+
     dl_state = meta.get("dataloader")
     if dl_state:
         # constant global batch -> pass-through; still validated so a
@@ -181,6 +215,7 @@ def main(argv=None) -> int:
                 for ax in elastic.TOPOLOGY_AXES}
     new_topo["dp"] = plan.dp_new
     new_topo["pp"] = pp_new
+    new_topo["slices"] = slices_new
     new_topo["world_size"] = 1
     for ax in elastic.TOPOLOGY_AXES:
         new_topo["world_size"] *= new_topo[ax]
@@ -197,6 +232,9 @@ def main(argv=None) -> int:
         print(f"  pipeline  pp {pp_old} -> {pp_new} (same padded layer "
               f"stack — metadata only; stage programs rebuild from "
               f"config at startup)")
+    if slices_new != slices_old:
+        print(f"  slices    {slices_old} -> {slices_new} (placement "
+              f"metadata only — no array touches a slice boundary)")
     if dl_state:
         print(f"  cursor    epoch {dl_state['epoch']}, sample "
               f"{dl_state['cursor']} (token-exact carry)")
@@ -206,6 +244,7 @@ def main(argv=None) -> int:
 
     meta["config"]["distributed"]["dp_size"] = plan.dp_new
     meta["config"]["distributed"]["pp_size"] = pp_new
+    meta["config"]["distributed"]["slices"] = slices_new
     meta["config"]["training"]["micro_batch_size"] = plan.micro_batch_size
     meta["config"]["training"]["gradient_accumulation_steps"] = \
         plan.gradient_accumulation_steps
@@ -230,6 +269,8 @@ def main(argv=None) -> int:
     else:
         print(f"  manifest  none (legacy step) — meta.json rewritten only")
     pp_hint = f" distributed.pp_size={pp_new}" if pp_new != pp_old else ""
+    if slices_new != slices_old:
+        pp_hint += f" distributed.slices={slices_new}"
     print(f"resume with distributed.dp_size={plan.dp_new}{pp_hint} "
           f"training.micro_batch_size={plan.micro_batch_size} "
           f"training.gradient_accumulation_steps="

@@ -141,6 +141,16 @@ PRESETS: dict[str, tuple] = {
                             dict(dp_size=2, tp_size=2, cp_size=2,
                                  slices=2, dcn_axes="dp"),
                             dict(gradient_accumulation_steps=2)),
+    # the dp-cross audit again on the FUSED grad engine under remat: the
+    # runtime hierarchical dp reduction (parallel/hier_reduce.py) sits at
+    # the engine seam, so the in-scan accumulator must still reach the
+    # same explicit reduce-scatter / DCN all-reduce / all-gather schedule
+    "tiny-dp-cross-fused": ("debug-tiny",
+                            dict(dp_size=2, tp_size=2, cp_size=2,
+                                 slices=2, dcn_axes="dp"),
+                            dict(gradient_accumulation_steps=2,
+                                 grad_engine="fused", remat=True,
+                                 remat_policy="dots_attn")),
     # same audit with the PIPELINE axis over DCN on the MPMD substrate:
     # stage-boundary ppermutes are the only declared crossers
     "tiny-pp-mpmd-cross": ("debug-tiny",
