@@ -123,10 +123,12 @@ def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
             ce_chunk: int = 0, optimizer_offload: bool = False,
             profile: str | None = None,
             profile_steps: int | None = None,
-            telemetry: str | None = None) -> dict:
+            telemetry: str | None = None,
+            trace: str | None = None) -> dict:
     from picotron_tpu.mesh import MeshEnv
     from picotron_tpu.parallel.api import init_sharded_state, make_train_step
     from picotron_tpu.telemetry import Histogram, JsonlSink
+    from picotron_tpu.telemetry.flightdeck import SpanTracer
     from picotron_tpu.utils import device_peak_flops, flops_per_token, mfu
 
     n_chips = len(jax.devices())
@@ -195,12 +197,20 @@ def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
     # bench_summary), the same stream tools/telemetry_report.py reads.
     hist = Histogram()
     sink = JsonlSink(telemetry) if telemetry else None
+    # ``--trace FILE``: record one flightdeck span per timed step and
+    # export the Chrome-trace JSON; the span-recording cost rides inside
+    # these samples, so p50 here vs the chained mean above IS the
+    # enabled-path overhead (plus the per-span microbench below, which
+    # measures the tracer call in isolation).
+    tracer = SpanTracer() if trace else None
     for i in range(steps):
         t0 = time.perf_counter()
         state, metrics = step(state, batch)
         float(metrics["loss"])  # value fetch: the step must have executed
         dt_i = time.perf_counter() - t0
         hist.observe(dt_i)
+        if tracer is not None:
+            tracer.complete("step", dur_s=dt_i, i=i)
         if sink is not None:
             sink.emit({"ts": time.time(), "kind": "bench_step", "i": i,
                        "secs": round(dt_i, 6),
@@ -227,6 +237,20 @@ def run_one(model: str, layers, seq: int, mbs: int, *, grad_acc: int = 1,
         "step_time_ms_p50": round(hist.p50 * 1e3, 2),
         "step_time_ms_p95": round(hist.p95 * 1e3, 2),
     }
+    if tracer is not None:
+        # Per-span cost measured in isolation (a train step records a
+        # handful of spans: 3-4 phases + any MPMD ticks): this is the
+        # number PERF.md documents as the enabled-path overhead.
+        probe = SpanTracer()
+        n_probe = 10_000
+        t0 = time.perf_counter()
+        for i in range(n_probe):
+            probe.complete("probe", dur_s=1e-6, i=i)
+        span_cost_us = (time.perf_counter() - t0) / n_probe * 1e6
+        tracer.export(trace)
+        row["trace"] = trace
+        row["trace_events"] = len(tracer)
+        row["trace_span_cost_us"] = round(span_cost_us, 3)
     if sink is not None:
         sink.emit({"ts": time.time(), "kind": "bench_summary", **row})
         sink.close()
@@ -1033,6 +1057,13 @@ def main() -> None:
                          "(in-flight fused-scan slices + xprof device "
                          "buffers; PERF.md). Use `--profile DIR "
                          "--profile-steps 1`.")
+    ap.add_argument("--trace", metavar="FILE", default=None,
+                    help="record a flightdeck span per timed step and "
+                         "export Chrome-trace/Perfetto JSON to FILE "
+                         "(validate with tools/trace_export.py "
+                         "--validate); adds trace_span_cost_us to the "
+                         "JSON row — the enabled-path overhead number "
+                         "PERF.md documents")
     ap.add_argument("--telemetry", metavar="FILE", default=None,
                     help="write per-step timing samples + the summary row "
                          "to this JSONL file (picotron_tpu/telemetry sink "
@@ -1260,6 +1291,7 @@ def main() -> None:
                     "profile_steps": (None, "--profile-steps"),
                     "tp": (1, "--tp"),
                     "telemetry": (None, "--telemetry"),
+                    "trace": (None, "--trace"),
                     "no_remat": (False, "--no-remat")}
         clashing = [flag for k, (v, flag) in defaults.items()
                     if getattr(args, k) != v]
@@ -1387,7 +1419,8 @@ def main() -> None:
         remat_policy=args.remat_policy,
         adam_moments_dtype=args.adam_moments_dtype, ce_chunk=args.ce_chunk,
         optimizer_offload=args.optimizer_offload, profile=args.profile,
-        profile_steps=args.profile_steps, telemetry=args.telemetry)))
+        profile_steps=args.profile_steps, telemetry=args.telemetry,
+        trace=args.trace)))
 
 
 if __name__ == "__main__":

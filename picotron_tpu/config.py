@@ -1013,6 +1013,62 @@ class LoggingConfig:
     # is frozen; tools/extract_metrics.py parses it).
     telemetry_jsonl: bool = True
     telemetry_dir: Optional[str] = None
+    # Size-capped JSONL rotation: when > 0, a telemetry.jsonl exceeding
+    # this many MB is rotated once to `telemetry.jsonl.1` and a fresh
+    # segment starts. Readers (tools/telemetry_report.py,
+    # tools/extract_metrics.py) read `.1` then the live file, so
+    # cross-restart replay accounting survives rotation. 0 = unbounded.
+    telemetry_max_mb: float = 0.0
+    # flightdeck span tracer (telemetry/flightdeck/tracer.py): a
+    # directory enables span recording (train phases, MPMD schedule
+    # ticks, serve request lifecycles, resilience instants) exported as
+    # Chrome-trace/Perfetto JSON `trace.json` on close. None disables —
+    # the disabled path allocates nothing.
+    trace_dir: Optional[str] = None
+    # flightdeck crash flight recorder (telemetry/flightdeck/flight.py):
+    # ring of the last N steps' phase timings + metrics + spans, dumped
+    # to `flightdeck_postmortem.json` on abnormal exits (watchdog 77,
+    # divergence abort/rollback, preemption 75, unhandled exceptions).
+    # 0 disables.
+    flight_steps: int = 8
+    # flightdeck drift sentinel (telemetry/flightdeck/sentinel.py):
+    # online watch of rolling step time, sync share vs the cost model's
+    # predicted exposed comm, and data-wait share. A quantity breaching
+    # `sentinel_ratio` x baseline (and `sentinel_zscore` sigmas where
+    # the window has variance) for `sentinel_patience` consecutive
+    # steps emits ONE `sentinel_alert` event and auto-dumps the flight
+    # recorder.
+    sentinel: bool = False
+    sentinel_window: int = 32
+    sentinel_zscore: float = 4.0
+    sentinel_ratio: float = 1.5
+    sentinel_patience: int = 3
+
+    def validate(self) -> None:
+        if self.telemetry_max_mb < 0:
+            raise ValueError(
+                f"logging.telemetry_max_mb must be >= 0 (0 disables "
+                f"rotation), got {self.telemetry_max_mb}")
+        if self.flight_steps < 0:
+            raise ValueError(
+                f"logging.flight_steps must be >= 0 (0 disables the "
+                f"flight recorder), got {self.flight_steps}")
+        if self.sentinel_window < 4:
+            raise ValueError(
+                f"logging.sentinel_window must be >= 4, got "
+                f"{self.sentinel_window}")
+        if self.sentinel_ratio <= 1.0:
+            raise ValueError(
+                f"logging.sentinel_ratio must be > 1.0, got "
+                f"{self.sentinel_ratio}")
+        if self.sentinel_zscore <= 0.0:
+            raise ValueError(
+                f"logging.sentinel_zscore must be > 0, got "
+                f"{self.sentinel_zscore}")
+        if self.sentinel_patience < 1:
+            raise ValueError(
+                f"logging.sentinel_patience must be >= 1, got "
+                f"{self.sentinel_patience}")
 
 
 @dataclass(frozen=True)
@@ -1088,6 +1144,7 @@ class Config:
     def validate(self) -> None:
         self.distributed.validate()
         self.model.validate()
+        self.logging.validate()
         self.resilience.validate()
         self.serve.validate()
         self.pipeline.validate()

@@ -66,6 +66,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from picotron_tpu import compat
 from picotron_tpu.config import Config
 from picotron_tpu.resilience import chaos, watchdog
+from picotron_tpu.telemetry import bus as telemetry_bus
+from picotron_tpu.telemetry.flightdeck.tracer import TID_PP_BASE
 from picotron_tpu.mesh import MeshEnv
 from picotron_tpu.models.llama import (
     compute_dtype, embed, final_hidden, head_weight, model_rope_tables,
@@ -680,6 +682,12 @@ def _run_schedule(stages, table, chunk_params, accs, state_scalars,
     never a half-walked schedule's partial grads."""
     V = len(stages)
     nll_acc, cnt_acc = state_scalars
+    # flightdeck span tracer (telemetry/flightdeck): one fetch per walk,
+    # then a None check per op. When tracing, each op is synced like the
+    # sampled-timings path so span durations are real tick times (an
+    # opt-in perturbation, same as PICOTRON_PP_TICK_SAMPLE).
+    _tel = telemetry_bus.active()
+    tracer = getattr(_tel, "tracer", None) if _tel is not None else None
     xbuf: dict = {}    # (vstage, mb) -> inbound activation
     xsave: dict = {}   # (vstage, mb) -> saved stage input for the backward
     gbuf: dict = {}    # (vstage, mb) -> inbound cotangent
@@ -694,7 +702,8 @@ def _run_schedule(stages, table, chunk_params, accs, state_scalars,
         if step is not None:
             chaos.fire("schedule_tick", step=step,
                        tick=op.tick, stage=j, op=op.op, mb=mb)
-        t0 = time.perf_counter() if timings is not None else 0.0
+        t0 = (time.perf_counter()
+              if (timings is not None or tracer is not None) else 0.0)
         if op.op == "F":
             if st.first:
                 y = st.fwd(chunk_params[j], ids_s, idx_first[mb])
@@ -730,12 +739,22 @@ def _run_schedule(stages, table, chunk_params, accs, state_scalars,
         else:  # pragma: no cover — zb tables are accounting-only
             raise RuntimeError(
                 f"op {op.op!r} has no executable stage program")
-        if timings is not None:
+        if timings is not None or tracer is not None:
             jax.block_until_ready(accs[j] if op.op == "B" else
                                   (nll_acc if st.last else
                                    xbuf.get((j + 1, mb))))
-            timings.setdefault(op.group, []).append(
-                time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            if timings is not None:
+                timings.setdefault(op.group, []).append(dt)
+            if tracer is not None:
+                # One span per dispatched op on the owning device
+                # group's lane, named with the same stage/tick/op/mb
+                # coordinates the watchdog's last-touch string uses.
+                tracer.complete(
+                    f"stage{j}/tick{op.tick}/{op.op}/mb{mb}",
+                    tid=TID_PP_BASE + op.group, dur_s=dt,
+                    stage=j, tick=op.tick, op=op.op, mb=mb,
+                    step=step)
     leftover = ([f"activation (vstage={j}, mb={m})" for j, m in sorted(xbuf)]
                 + [f"cotangent (vstage={j}, mb={m})" for j, m in sorted(gbuf)]
                 + [f"saved-input (vstage={j}, mb={m})"

@@ -120,6 +120,18 @@ class Watchdog:
                      phase=phase, step=step, timeout=self.timeout)
         except Exception:  # noqa: BLE001 — the exit below must still happen
             pass
+        # Flight-recorder postmortem: the last-K-steps window, written
+        # before the exit below (os._exit runs no cleanup handlers, so
+        # this is the only chance).
+        try:
+            from picotron_tpu.telemetry import bus
+
+            tel = bus.active()
+            if tel is not None and getattr(tel, "flight", None) is not None:
+                tel.flight.dump("watchdog", step=step, phase=phase,
+                                stalled_s=round(age, 3))
+        except Exception:  # noqa: BLE001 — the exit below must still happen
+            pass
         try:
             dump_all_stacks(sys.stderr)
         except Exception:  # noqa: BLE001 — the exit below must still happen

@@ -349,7 +349,8 @@ class DisaggServeEngine(ServeEngine):
             n_prefilled = int(nval.sum())
             self.telemetry.emit("phase", phase="prefill",
                                 category="prefill", secs=dt,
-                                tokens=n_prefilled, pool="prefill")
+                                tokens=n_prefilled, pool="prefill",
+                                ids=[int(rids[s]) for s in pslots])
             for s in pslots:
                 self.sched.note_prefilled(s, int(nval[s]))
             self.stats["prefill_chunks"] += len(pslots)

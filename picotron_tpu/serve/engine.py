@@ -454,7 +454,8 @@ class ServeEngine:
             n_prefilled = int(nval.sum())
             self.telemetry.emit("phase", phase="prefill",
                                 category="prefill", secs=dt,
-                                tokens=n_prefilled)
+                                tokens=n_prefilled,
+                                ids=[int(rids[s]) for s in pslots])
             for s in pslots:
                 self.sched.note_prefilled(s, int(nval[s]))
             self.stats["prefill_chunks"] += len(pslots)
@@ -588,6 +589,10 @@ class ServeEngine:
                 if csecs:
                     self.stats["decode_compiles"] += 1
                 dt -= min(csecs, dt)
+                # Request ids snapshotted before the retire loop below
+                # frees slots — tags the decode phase event (and its
+                # flightdeck span) with the requests it advanced.
+                dec_ids = [self.sched.slots[s].req.id for s in active]
                 n_tokens = 0
                 for s in active:
                     st = self.sched.slots[s]
@@ -616,7 +621,7 @@ class ServeEngine:
                                 break
                 self.telemetry.emit("phase", phase="decode",
                                     category="decode", secs=dt,
-                                    tokens=n_tokens)
+                                    tokens=n_tokens, ids=dec_ids)
                 reg.histogram("serve/token_latency").observe(
                     dt / max(n_tokens if self.speculate
                              else len(active) * interval, 1))

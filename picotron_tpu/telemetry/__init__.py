@@ -38,6 +38,7 @@ import time
 from typing import Optional
 
 from picotron_tpu.telemetry import bus
+from picotron_tpu.telemetry.flightdeck.tracer import TID_SERVE, TID_TRAIN
 from picotron_tpu.telemetry.goodput import (
     CATEGORIES, GOODPUT_CATEGORIES, PHASE_CATEGORY, GoodputLedger,
 )
@@ -70,6 +71,16 @@ __all__ = [
     "telemetry_jsonl_path",
 ]
 
+# Serve-engine request-lifecycle phases: traced on the serve lane with
+# their request ids rather than the train lane.
+_SERVE_PHASES = frozenset(("queue_wait", "prefill", "decode", "handoff"))
+# Resilience/fault event kinds rendered as trace instants so a timeline
+# shows the fault next to the phase it interrupted.
+_INSTANT_KINDS = frozenset((
+    "chaos", "guard", "rollback", "preempted", "preempt_signal",
+    "watchdog_timeout", "elastic_resize", "recompile", "retry",
+    "sentinel_alert", "slice_lost"))
+
 
 class Telemetry:
     """Facade wiring registry + sinks + phases + ledger + compile watch.
@@ -96,6 +107,13 @@ class Telemetry:
         # schedule table, parallel/mpmd.pipeline_bubble_fraction) —
         # installed by the driver once per run, 0.0 when pp is off.
         self.pp_bubble_fraction = 0.0
+        # flightdeck attachments (telemetry/flightdeck): all nullable —
+        # the hot-path hooks below are a single `is not None` check when
+        # a piece is absent, allocating nothing.
+        self.tracer = None          # SpanTracer
+        self.flight = None          # FlightRecorder
+        self.sentinel = None        # DriftSentinel
+        self.trace_path = None      # where close() exports the trace
         self._closed = False
         # Anchor the stream's wall-clock: compiles/setup before the first
         # phase would otherwise make the report's `accounted` exceed its
@@ -112,8 +130,17 @@ class Telemetry:
         sinks: list = [StdoutSink(is_primary=is_primary)]
         path = telemetry_jsonl_path(cfg, jax.process_index())
         if path is not None:
-            sinks.append(JsonlSink(path))
-        return cls(sinks=sinks, watchdog=watchdog)
+            max_mb = float(getattr(cfg.logging, "telemetry_max_mb", 0.0)
+                           or 0.0)
+            sinks.append(JsonlSink(
+                path,
+                max_bytes=int(max_mb * 1e6) if max_mb > 0 else None))
+        tel = cls(sinks=sinks, watchdog=watchdog)
+        from picotron_tpu.telemetry import flightdeck
+
+        flightdeck.install(tel, cfg,
+                           process_index=jax.process_index())
+        return tel
 
     def attach_watchdog(self, watchdog) -> None:
         self.phases.watchdog = watchdog
@@ -154,6 +181,41 @@ class Telemetry:
         if secs is not None:
             event["secs"] = round(secs, 6)
         self._fan_out(event)
+        if self.tracer is not None:
+            self._trace_event(kind, secs, fields)
+        if self.flight is not None:
+            if kind == "phase":
+                self.flight.on_phase(fields.get("phase") or "?",
+                                     secs or 0.0,
+                                     step=fields.get("step"))
+            elif kind not in ("compile", "pp_bubble"):
+                self.flight.on_event(kind, fields)
+        if self.sentinel is not None and kind == "phase" \
+                and isinstance(secs, (int, float)):
+            self.sentinel.observe_phase(fields.get("phase") or "", secs)
+
+    def _trace_event(self, kind: str, secs, fields: dict) -> None:
+        """Route one bus event onto the span timeline: phase events
+        become complete spans (serve request phases on the serve lane,
+        tagged with their request ids; everything else on the train
+        lane), resilience/fault kinds become instants."""
+        tr = self.tracer
+        if kind == "phase":
+            if not isinstance(secs, (int, float)):
+                return
+            phase = fields.get("phase") or "?"
+            args = {k: fields[k] for k in ("id", "ids", "tokens", "step")
+                    if fields.get(k) is not None}
+            tid = TID_SERVE if phase in _SERVE_PHASES else TID_TRAIN
+            tr.complete(phase, tid=tid, dur_s=secs, **args)
+        elif kind == "compile" and isinstance(secs, (int, float)):
+            args = ({"step": fields["step"]}
+                    if fields.get("step") is not None else {})
+            tr.complete("compile", tid=TID_TRAIN, dur_s=secs, **args)
+        elif kind in _INSTANT_KINDS:
+            args = {k: v for k, v in fields.items()
+                    if isinstance(v, (int, float, str, bool))}
+            tr.instant(kind, tid=TID_TRAIN, **args)
 
     def _fan_out(self, event: dict) -> None:
         for sink in self.sinks:
@@ -234,6 +296,16 @@ class Telemetry:
         stdout byte-identically; the structured fields go to JSONL/wandb."""
         self._fan_out({"ts": time.time(), "kind": "step", "step": step,
                        "line": line, **fields})
+        if self.flight is not None:
+            self.flight.on_step(step, fields)
+        if self.sentinel is not None:
+            alert = self.sentinel.on_step(step)
+            if alert is not None:
+                self.emit("sentinel_alert", **alert)
+                if self.flight is not None:
+                    self.flight.dump("sentinel_alert",
+                                     step=alert.get("step", step),
+                                     alert=alert)
 
     def record_eval(self, step: int, val_loss: float, line: str) -> None:
         self._fan_out({"ts": time.time(), "kind": "eval", "step": step,
@@ -245,9 +317,17 @@ class Telemetry:
         if self._closed:
             return
         self._closed = True
-        self._fan_out({"ts": time.time(), "kind": "run_summary",
-                       "goodput": self.ledger.summary(),
-                       "metrics": self.registry.snapshot()})
+        summary = {"ts": time.time(), "kind": "run_summary",
+                   "goodput": self.ledger.summary(),
+                   "metrics": self.registry.snapshot()}
+        if self.sentinel is not None:
+            summary["sentinel"] = self.sentinel.stats()
+        self._fan_out(summary)
+        if self.tracer is not None and self.trace_path:
+            try:
+                self.tracer.export(self.trace_path)
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
         self.compile_watch.uninstall()
         for sink in self.sinks:
             try:

@@ -55,8 +55,21 @@ class StdoutSink(Sink):
 
 
 class JsonlSink(Sink):
-    def __init__(self, path: str):
+    """Append-mode JSONL writer with optional size-capped rotation.
+
+    With ``max_bytes`` set, a stream that outgrows the cap is rotated
+    once: the current file becomes ``<path>.1`` (replacing any previous
+    rotation) and a fresh segment starts at ``<path>``. Readers that
+    care about the whole saga (tools/telemetry_report.py,
+    tools/extract_metrics.py — cross-restart replay counting needs
+    event ORDER) read ``<path>.1`` first, then ``<path>``; see
+    ``jsonl_segments``. Rotation happens on event boundaries, so no
+    line is ever split across segments.
+    """
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None):
         self.path = path
+        self.max_bytes = max_bytes
         self._lock = threading.Lock()
         self._f = open(path, "a")
 
@@ -69,6 +82,18 @@ class JsonlSink(Sink):
                 return
             self._f.write(json.dumps(rec) + "\n")
             self._f.flush()
+            if self.max_bytes and self._f.tell() >= self.max_bytes:
+                self._rotate()
+
+    def _rotate(self) -> None:
+        import os
+
+        self._f.close()
+        try:
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass  # rotation is best-effort; keep appending in place
+        self._f = open(self.path, "a")
 
     def close(self) -> None:
         with self._lock:
@@ -107,6 +132,15 @@ class WandbSink(Sink):
         except Exception as e:  # noqa: BLE001 — mirror train.py's old fence
             print(f"wandb finish failed during shutdown: {e!r}",
                   file=sys.stderr)
+
+
+def jsonl_segments(path: str) -> list:
+    """Existing segments of a possibly-rotated JSONL stream, oldest
+    first (``<path>.1`` then ``<path>``) — the read order that keeps
+    cross-restart replay counting correct after rotation."""
+    import os
+
+    return [p for p in (path + ".1", path) if os.path.exists(p)]
 
 
 def telemetry_jsonl_path(cfg, process_index: int = 0) -> Optional[str]:

@@ -324,6 +324,8 @@ def main(argv=None) -> None:
     tel = telemetry_bus.install(Telemetry.from_config(cfg))
     if tel.jsonl_path:
         log_print(f"telemetry -> {tel.jsonl_path}")
+    if tel.trace_path:
+        log_print(f"flightdeck trace -> {tel.trace_path}")
     if cfg.distributed.pp_size > 1:
         # Book the analytic fill/drain share of every step into the
         # pp_bubble ledger category (both executors — the schedule table
@@ -487,6 +489,9 @@ def main(argv=None) -> None:
                 if action is GuardAction.ABORT:
                     log_print(f"[guard {step:06d}] {why}; aborting "
                               f"(exit {EXIT_DIVERGED})")
+                    if tel.flight is not None:
+                        tel.flight.dump("divergence_abort", step=step,
+                                        why=why)
                     exit_code = EXIT_DIVERGED
                     break
                 if action is GuardAction.SKIP:
@@ -503,6 +508,12 @@ def main(argv=None) -> None:
                                   f"state preserved)")
                 elif action is GuardAction.ROLLBACK:
                     bad_step = step
+                    if tel.flight is not None:
+                        # Dump BEFORE restoring: the window still holds
+                        # the diverging steps, and _rollback can itself
+                        # exit (no valid checkpoint -> EXIT_DIVERGED).
+                        tel.flight.dump("rollback", step=bad_step,
+                                        why=why)
                     with ph.phase("rollback", step):
                         state, step, trained_tokens = _rollback(
                             ckpt_mgr, state, dl, step, trained_tokens, why)
@@ -571,6 +582,8 @@ def main(argv=None) -> None:
                         cfg, menv, ckpt_mgr, state, trained_tokens, dl,
                         saved_steps)
                 tel.emit("preempted", step=step)
+                if tel.flight is not None:
+                    tel.flight.dump("preempted", step=step)
                 log_print(f"preempted at step {step}; state is durable — "
                           f"exiting {EXIT_PREEMPTED} for auto_resume")
                 exit_code = EXIT_PREEMPTED
@@ -587,6 +600,14 @@ def main(argv=None) -> None:
                 with ph.phase("save", int(state.step)):
                     ckpt_mgr.save(state, trained_tokens,
                                   dataloader_state=dl.state)
+    except SystemExit:
+        raise  # deliberate exits (rollback-without-ckpt) dumped above
+    except BaseException as e:  # noqa: BLE001
+        # Unhandled crash: leave the last-K-steps window next to the
+        # checkpoints before the teardown below runs.
+        if tel.flight is not None:
+            tel.flight.dump("exception", step=step, error=repr(e))
+        raise
     finally:
         # Always-run teardown: a mid-run crash must not leak the producer
         # thread, a half-written async checkpoint, an open trace, or a

@@ -346,6 +346,43 @@ def test_retry_backoff_heartbeats_watchdog():
     assert not fired
 
 
+def test_watchdog_fire_writes_flight_postmortem(tmp_path):
+    """The flightdeck pin: when the watchdog fires, the installed
+    telemetry facade's flight recorder dumps its last-K-steps window
+    BEFORE the exit path runs — the postmortem is the only record an
+    os._exit(77) leaves behind."""
+    from picotron_tpu.telemetry import Telemetry, bus
+    from picotron_tpu.telemetry.flightdeck import FlightRecorder
+    from picotron_tpu.telemetry.flightdeck.flight import POSTMORTEM_NAME
+
+    tel = Telemetry(sinks=[])
+    tel.flight = FlightRecorder(str(tmp_path), max_steps=4)
+    bus.install(tel)
+    fired = []
+    try:
+        tel.emit("phase", phase="data", secs=0.1, book=False, step=3)
+        tel.record_step(3, "[step] ...", loss=2.0)
+        w = Watchdog(timeout=0.2, on_timeout=lambda: fired.append(1))
+        w.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while not fired and time.monotonic() < deadline:
+                time.sleep(0.05)
+        finally:
+            w.stop()
+    finally:
+        tel.close()
+    assert fired
+    doc = json.load(open(tmp_path / POSTMORTEM_NAME))
+    assert doc["reason"] == "watchdog"
+    assert doc["step"] == 3  # the last step the recorder saw
+    assert doc["steps"][-1]["step"] == 3
+    assert doc["extra"]["stalled_s"] >= 0.2
+    # the watchdog_timeout bus event reached the recorder too
+    assert any(e["kind"] == "watchdog_timeout"
+               for e in doc["recent_events"])
+
+
 def test_preemption_handler_catches_sigterm_and_restores():
     h = PreemptionHandler()
     prev = signal.getsignal(signal.SIGTERM)
@@ -616,6 +653,51 @@ def test_chaos_cli_lists_every_scenario(capsys):
                  "data_stall", "ckpt_corrupt_bitflip", "dp_resize",
                  "pp_resize", "slice_lost", "mpmd_sigterm"):
         assert name in out
+
+
+def test_chaos_postmortem_matcher_structural(tmp_path):
+    """Fast structural pin for the scenarios' check_after_fault hook:
+    tools/chaos.py asserts that each abnormal exit left a flightdeck
+    postmortem whose reason and last recorded step equal the injected
+    fault — exercised here against crafted dumps instead of a full
+    kill-and-recover run."""
+    cli = _load_chaos_cli()
+    p = tmp_path / "flightdeck_postmortem.json"
+    # the hook is wired into the three abnormal-exit scenarios, each
+    # bound to its fault's reason and injection step
+    expected = {"sigterm": ("preempted", cli.STEPS // 2),
+                "nan_rollback": ("rollback", cli.STEPS - 2),
+                "data_stall": ("watchdog", cli.STEPS // 2)}
+    for name, (reason, fault_step) in expected.items():
+        sc = cli.SCENARIOS[name]
+        assert sc.check_after_fault is not None, name
+        if p.exists():
+            p.unlink()
+        # with no postmortem on disk the scenario must fail loudly
+        err = sc.check_after_fault(str(tmp_path))
+        assert err and "flightdeck_postmortem.json" in err, (name, err)
+        # the matching dump passes; a wrong reason or step does not
+        p.write_text(json.dumps({
+            "reason": reason, "step": fault_step, "ts": 0.0,
+            "steps": [{"step": fault_step, "phases": {"step": 1.0}}],
+            "recent_events": []}))
+        assert sc.check_after_fault(str(tmp_path)) is None, name
+        p.write_text(json.dumps({
+            "reason": "exception", "step": fault_step, "ts": 0.0,
+            "steps": [{"step": fault_step}], "recent_events": []}))
+        assert "reason" in sc.check_after_fault(str(tmp_path)), name
+    good = {"reason": "preempted", "step": 3, "ts": 0.0,
+            "steps": [{"step": 3, "phases": {"step": 1.0}}],
+            "recent_events": []}
+    p.write_text(json.dumps(good))
+    assert "step" in cli._postmortem_matches(
+        str(tmp_path), reason="preempted", fault_step=4)
+    p.write_text(json.dumps({**good, "steps": []}))
+    assert "empty" in cli._postmortem_matches(
+        str(tmp_path), reason="preempted", fault_step=3)
+    p.write_text("{torn")
+    assert "unreadable" in cli._postmortem_matches(
+        str(tmp_path), reason="preempted", fault_step=3)
 
 
 # Per-scenario telemetry assertions: the injected fault's cost must be

@@ -17,6 +17,7 @@ from picotron_tpu.telemetry import (
     PhaseTimer, StdoutSink, Telemetry, WandbSink, bus,
     telemetry_jsonl_path,
 )
+from picotron_tpu.telemetry.sinks import jsonl_segments
 
 
 def load_report():
@@ -95,6 +96,52 @@ def test_jsonl_sink_roundtrip_strips_line_and_appends(tmp_path):
     rows = [json.loads(ln) for ln in open(p)]
     assert [r["step"] for r in rows] == [1, 2, 3]
     assert "line" not in rows[0]  # presentation, not data
+
+
+def test_jsonl_sink_rotates_at_size_cap(tmp_path):
+    """logging.telemetry_max_mb: when the live segment crosses the byte
+    cap it is renamed to `<path>.1` (one older segment kept) and the
+    stream continues in a fresh file — a week-long run's telemetry is
+    bounded at ~2x the cap, and no event is lost at the seam."""
+    p = str(tmp_path / "t.jsonl")
+    s = JsonlSink(p, max_bytes=300)
+    for step in range(1, 13):
+        s.emit({"kind": "step", "step": step, "loss": 2.5})
+    s.close()
+    assert os.path.exists(p + ".1")  # rotation happened
+    assert os.path.getsize(p) < 400  # live segment stays near the cap
+    # oldest-first reading reassembles the unbroken stream
+    assert jsonl_segments(p) == [p + ".1", p]
+    steps = []
+    for seg in jsonl_segments(p):
+        steps += [json.loads(ln)["step"] for ln in open(seg)]
+    assert steps == list(range(1, 13))
+    # a second overflow drops the oldest segment (the documented bound:
+    # telemetry disk stays ~2x the cap, the tail survives)
+    s2 = JsonlSink(p, max_bytes=300)
+    for step in range(13, 25):
+        s2.emit({"kind": "step", "step": step, "loss": 2.5})
+    s2.close()
+    tail = []
+    for seg in jsonl_segments(p):
+        tail += [json.loads(ln)["step"] for ln in open(seg)]
+    assert tail[-1] == 24
+    assert tail == sorted(tail) and len(tail) < 24
+    # an unrotated stream is a single segment; a missing one is none
+    single = str(tmp_path / "single.jsonl")
+    JsonlSink(single).close()
+    assert jsonl_segments(single) == [single]
+    assert jsonl_segments(str(tmp_path / "absent.jsonl")) == []
+
+
+def test_jsonl_sink_unbounded_by_default(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    s = JsonlSink(p)
+    for step in range(200):
+        s.emit({"kind": "step", "step": step})
+    s.close()
+    assert not os.path.exists(p + ".1")
+    assert len(open(p).readlines()) == 200
 
 
 def test_stdout_sink_gates_on_primary(capsys):
@@ -700,6 +747,93 @@ def test_extract_metrics_telemetry_replay_keeps_last_record(tmp_path):
     ])
     stats = em.process_run(str(run), skip_steps=3)
     assert stats["steps"] == 1 and stats["final_loss"] == 2.0
+
+
+def test_report_reads_rotated_stream_oldest_first(tmp_path):
+    """A size-rotated stream (telemetry.jsonl.1 + telemetry.jsonl) must
+    be read oldest segment first: the replayed-step bookkeeping is
+    order-sensitive — reading the live segment first would count the
+    re-trained step as first-sight and the original as the replay."""
+    rep = load_report()
+    p = str(tmp_path / "telemetry.jsonl")
+    with open(p + ".1", "w") as f:
+        for s in (1, 2, 3):
+            f.write(json.dumps({"ts": float(s), "kind": "phase",
+                                "phase": "step", "step": s,
+                                "category": "compute", "secs": 2.0}) + "\n")
+    _write_events(p, [
+        {"ts": 4.0, "kind": "phase", "phase": "step", "step": 3,
+         "category": "compute", "secs": 2.0},  # re-trained after rollback
+        {"ts": 5.0, "kind": "phase", "phase": "step", "step": 4,
+         "category": "compute", "secs": 2.0},
+    ])
+    s = rep.summarize(rep.load_events(p))
+    assert s["steps"] == {"count": 4, "max": 4, "replayed": 1}
+    assert s["categories"]["replay"] == 2.0
+    assert s["categories"]["compute"] == 8.0
+
+
+def test_report_sentinel_section_from_alert_events(tmp_path):
+    rep = load_report()
+    p = tmp_path / "telemetry.jsonl"
+    _write_events(p, [
+        {"ts": 1.0, "kind": "phase", "phase": "step", "step": 1,
+         "category": "compute", "secs": 1.0},
+        {"ts": 2.0, "kind": "sentinel_alert", "quantity": "sync_share",
+         "value": 0.45, "baseline": 0.1, "ratio": 4.5, "step": 40},
+    ])
+    s = rep.summarize(rep.load_events(str(p)))
+    assert s["sentinel"] == {"alerts": 1, "quantity": "sync_share",
+                             "worst_ratio": 4.5}
+    text = rep.render(s)
+    assert "sentinel: 1 alert(s) — worst sync_share at 4.50x baseline" \
+        in text
+    md = rep.render(s, markdown=True)
+    assert "**sentinel: 1 alert(s)" in md
+    # clean stream: no sentinel row at all
+    p2 = tmp_path / "clean.jsonl"
+    _write_events(p2, [
+        {"ts": 1.0, "kind": "phase", "phase": "step", "step": 1,
+         "category": "compute", "secs": 1.0}])
+    s2 = rep.summarize(rep.load_events(str(p2)))
+    assert "sentinel" not in s2
+    assert "sentinel" not in rep.render(s2)
+
+
+def test_extract_metrics_sentinel_column_and_rotated_stream(tmp_path):
+    """The harvester satellite: `sentinel_alerts` is a first-class sweep
+    column (0 on a clean run, counted across rotated segments) so a
+    regression sweep can filter on it."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import extract_metrics as em
+
+    run = tmp_path / "run"
+    run.mkdir()
+    p = str(run / "telemetry.jsonl")
+    with open(p + ".1", "w") as f:
+        for s in range(1, 4):
+            f.write(json.dumps({"kind": "step", "step": s, "loss": 3.0,
+                                "tokens_per_sec": 1.0,
+                                "tokens_per_sec_per_chip": 1.0,
+                                "mfu": 0.0}) + "\n")
+    _write_events(run / "telemetry.jsonl", [
+        {"kind": "sentinel_alert", "quantity": "step_time", "value": 3.0,
+         "baseline": 1.0, "ratio": 3.0, "step": 5},
+        {"kind": "step", "step": 5, "loss": 2.0, "tokens_per_sec": 1.0,
+         "tokens_per_sec_per_chip": 1.0, "mfu": 0.0},
+    ])
+    stats = em.process_run(str(run), skip_steps=0)
+    assert stats["sentinel_alerts"] == 1
+    assert stats["steps"] == 4  # both segments consulted
+    assert stats["final_loss"] == 2.0
+
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    _write_events(clean / "telemetry.jsonl", [
+        {"kind": "step", "step": 1, "loss": 2.0, "tokens_per_sec": 1.0,
+         "tokens_per_sec_per_chip": 1.0, "mfu": 0.0}])
+    assert em.process_run(str(clean), skip_steps=0)["sentinel_alerts"] == 0
 
 
 def test_extract_metrics_falls_back_to_log_when_jsonl_empty(tmp_path):

@@ -104,6 +104,8 @@ SCENARIOS: dict[str, Scenario] = {
         marker=r"emergency checkpoint ->",
         note="preemption mid-run: finish step, emergency ckpt, exit "
              f"{EXIT_PREEMPTED}, auto_resume",
+        check_after_fault=lambda save_dir: _postmortem_matches(
+            save_dir, reason="preempted", fault_step=STEPS // 2),
     ),
     "ckpt_io": Scenario(
         # Two injected write failures at the step-2 save; the default
@@ -126,6 +128,8 @@ SCENARIOS: dict[str, Scenario] = {
         marker=r"rolled back to step",
         note="NaN gradients: restore last durable ckpt, skip the poison "
              "data range, re-train",
+        check_after_fault=lambda save_dir: _postmortem_matches(
+            save_dir, reason="rollback", fault_step=STEPS - 2),
     ),
     "data_stall": Scenario(
         # Producer sleeps far longer than the watchdog timeout; the
@@ -138,6 +142,8 @@ SCENARIOS: dict[str, Scenario] = {
         marker=r"\[watchdog\] no progress",
         note="stalled data producer: watchdog stack-dump + exit "
              f"{EXIT_WATCHDOG}, supervisor restart, auto_resume",
+        check_after_fault=lambda save_dir: _postmortem_matches(
+            save_dir, reason="watchdog", fault_step=STEPS // 2),
     ),
     "ckpt_corrupt_bitflip": Scenario(
         # The step-4 periodic save commits (manifest written), a byte in
@@ -826,6 +832,30 @@ def _doctor_flags_exactly(save_dir: str, corrupt_step: int):
                 f"exactly [{corrupt_step}] (rows: {rows})")
     if not good:
         return f"ckpt_doctor found no restorable step besides the corrupt one"
+    return None
+
+
+def _postmortem_matches(save_dir: str, reason: str, fault_step: int):
+    """The flightdeck flight recorder (telemetry/flightdeck/flight.py)
+    must have left a postmortem dump next to the checkpoints whose
+    reason and last recorded step match the injected fault — the
+    abnormal-exit half of the flightdeck acceptance criteria."""
+    path = os.path.join(save_dir, "flightdeck_postmortem.json")
+    if not os.path.exists(path):
+        return f"no flightdeck_postmortem.json under {save_dir}"
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return f"unreadable postmortem {path}: {e}"
+    if doc.get("reason") != reason:
+        return (f"postmortem reason {doc.get('reason')!r} != expected "
+                f"{reason!r}")
+    if doc.get("step") != fault_step:
+        return (f"postmortem last recorded step {doc.get('step')!r} != "
+                f"fault step {fault_step}")
+    if not doc.get("steps"):
+        return "postmortem carries an empty last-K-steps window"
     return None
 
 

@@ -128,39 +128,48 @@ def process_telemetry(path: str, skip_steps: int = 3) -> dict | None:
     val_losses: list[float] = []
     categories: dict[str, float] = {}
     serve_summary: dict | None = None
-    with open(path) as f:
-        for raw in f:
-            raw = raw.strip()
-            if not raw:
-                continue
-            try:
-                ev = json.loads(raw)
-            except json.JSONDecodeError:
-                continue  # torn tail line of a killed run
-            kind = ev.get("kind")
-            secs = ev.get("secs")
-            if ev.get("category") is not None \
-                    and isinstance(secs, (int, float)):
-                categories[ev["category"]] = \
-                    categories.get(ev["category"], 0.0) + secs
-            if kind == "serve_summary":
-                serve_summary = ev  # last wins (mirrors telemetry_report)
-            if kind == "step" and "step" in ev:
-                row = {
-                    "step": int(ev["step"]),
-                    "loss": float(ev.get("loss", float("nan"))),
-                    "tokens_per_sec": float(ev.get("tokens_per_sec", 0.0)),
-                    "tokens_per_sec_per_chip": float(
-                        ev.get("tokens_per_sec_per_chip", 0.0)),
-                    "mfu_pct": 100.0 * float(ev.get("mfu", 0.0)),
-                }
-                for k, v in ev.items():
-                    if k not in _STABLE_STEP_FIELDS \
-                            and isinstance(v, (int, float)):
-                        row["extra_" + k] = float(v)
-                rows_by_step[row["step"]] = row
-            elif kind == "eval" and "val_loss" in ev:
-                val_losses.append(float(ev["val_loss"]))
+    sentinel_alerts = 0
+    # A size-rotated stream (logging.telemetry_max_mb) keeps its older
+    # half in `<path>.1`; read it first so replayed-step bookkeeping
+    # (last record wins) sees events in emission order.
+    segments = [p for p in (path + ".1", path) if os.path.exists(p)]
+    for seg in segments or [path]:
+        with open(seg) as f:
+            for raw in f:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    ev = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue  # torn tail line of a killed run
+                kind = ev.get("kind")
+                secs = ev.get("secs")
+                if ev.get("category") is not None \
+                        and isinstance(secs, (int, float)):
+                    categories[ev["category"]] = \
+                        categories.get(ev["category"], 0.0) + secs
+                if kind == "serve_summary":
+                    serve_summary = ev  # last wins (mirrors telemetry_report)
+                if kind == "sentinel_alert":
+                    sentinel_alerts += 1
+                if kind == "step" and "step" in ev:
+                    row = {
+                        "step": int(ev["step"]),
+                        "loss": float(ev.get("loss", float("nan"))),
+                        "tokens_per_sec": float(
+                            ev.get("tokens_per_sec", 0.0)),
+                        "tokens_per_sec_per_chip": float(
+                            ev.get("tokens_per_sec_per_chip", 0.0)),
+                        "mfu_pct": 100.0 * float(ev.get("mfu", 0.0)),
+                    }
+                    for k, v in ev.items():
+                        if k not in _STABLE_STEP_FIELDS \
+                                and isinstance(v, (int, float)):
+                            row["extra_" + k] = float(v)
+                    rows_by_step[row["step"]] = row
+                elif kind == "eval" and "val_loss" in ev:
+                    val_losses.append(float(ev["val_loss"]))
     rows = [r for _, r in sorted(rows_by_step.items())
             if r["step"] > skip_steps]
     serve_cols = {}
@@ -179,6 +188,9 @@ def process_telemetry(path: str, skip_steps: int = 3) -> dict | None:
     if accounted > 0:
         out["goodput_pct"] = round(
             100.0 * categories.get("compute", 0.0) / accounted, 2)
+    # drift-sentinel alert count (telemetry/flightdeck): 0 on a clean
+    # run — the column exists either way so sweeps can filter on it
+    out["sentinel_alerts"] = sentinel_alerts
     return out
 
 

@@ -33,6 +33,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from picotron_tpu.telemetry.goodput import (  # noqa: E402
     GOODPUT_CATEGORIES,
 )
+from picotron_tpu.telemetry.sinks import jsonl_segments  # noqa: E402
 
 
 def resolve_path(path: str) -> str:
@@ -46,18 +47,22 @@ def resolve_path(path: str) -> str:
 
 
 def load_events(path: str) -> list[dict]:
+    """Read the stream, including a rotated `.1` segment first when
+    logging.telemetry_max_mb rotation left one — event ORDER across
+    segments is what keeps cross-restart replay counting correct."""
     events = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                ev = json.loads(line)
-            except json.JSONDecodeError:
-                continue  # torn final line of a killed run is expected
-            if isinstance(ev, dict):
-                events.append(ev)
+    for seg in jsonl_segments(path) or [path]:
+        with open(seg) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn final line of a killed run is expected
+                if isinstance(ev, dict):
+                    events.append(ev)
     return events
 
 
@@ -85,6 +90,7 @@ def summarize(events: list[dict]) -> dict:
     serve_reqs: list[dict] = []
     serve_summary: dict | None = None
     run_summary: dict | None = None
+    sentinel_alerts: list[dict] = []
     ts = [e["ts"] for e in events if isinstance(e.get("ts"), (int, float))]
 
     for e in events:
@@ -116,6 +122,8 @@ def summarize(events: list[dict]) -> dict:
             serve_summary = e  # last wins (one per engine run)
         elif kind == "run_summary":
             run_summary = e  # last wins (one per process lifetime)
+        elif kind == "sentinel_alert":
+            sentinel_alerts.append(e)
 
     accounted = sum(categories.values())
     goodput = sum(categories.get(c, 0.0) for c in GOODPUT_CATEGORIES)
@@ -177,6 +185,17 @@ def summarize(events: list[dict]) -> dict:
     pp = pipeline_view(categories, run_summary)
     if pp:
         out["pipeline"] = pp
+    if sentinel_alerts:
+        # Drift-sentinel row (telemetry/flightdeck/sentinel.py): one
+        # alert per drifting run — the worst measured/baseline ratio
+        # names the quantity to chase.
+        worst = max(sentinel_alerts,
+                    key=lambda a: a.get("ratio") or 0.0)
+        out["sentinel"] = {
+            "alerts": len(sentinel_alerts),
+            "quantity": worst.get("quantity"),
+            "worst_ratio": round(float(worst.get("ratio") or 0.0), 4),
+        }
     return out
 
 
@@ -427,6 +446,14 @@ def render(s: dict, markdown: bool = False) -> str:
     if rz:
         msg = (f"elastic resize: {rz['events']} topology-change "
                f"restore(s), {rz['seconds']:.3f}s booked as resize")
+        lines.append(f"**{msg}**" if markdown else msg)
+        lines.append("")
+    sn = s.get("sentinel")
+    if sn:
+        msg = (f"sentinel: {sn['alerts']} alert(s) — worst "
+               f"{sn['quantity']} at {sn['worst_ratio']:.2f}x baseline "
+               f"(flight recorder auto-dumped; see "
+               f"flightdeck_postmortem.json)")
         lines.append(f"**{msg}**" if markdown else msg)
         lines.append("")
     ev = ", ".join(f"{k}={v}" for k, v in s["events"].items())
