@@ -519,6 +519,123 @@ def run_serve(model: str, layers, *, slots: int, block_size: int,
     }
 
 
+def run_serve_fleet(model: str, layers, *, fleet: int, slots: int,
+                    block_size: int, num_blocks: int, prefill_chunk: int,
+                    prompt_len: int, max_new: int, n_requests: int,
+                    rate: float, decode_interval: int = 4, seed: int = 0,
+                    temperature: float = 0.0, deadline_ms: float = 0.0,
+                    chaos_spec: str | None = None, tick_s: float = 0.001,
+                    telemetry: str | None = None) -> dict:
+    """Fleet serving (picotron_tpu/serve/fleet): N engine replicas behind
+    one queue on a synthetic arrival trace, with optional serve-side
+    chaos (engine_dead@REQ / decode_hang@REQ~SECS / shed_storm@REQ) and
+    deadline load shedding. One JSON line, built for the recovery
+    scenarios in tools/chaos.py: per-request sha1 token digests (the
+    failover-parity oracle compares them across fleet sizes and fault
+    legs), the shed id set (deterministic on the virtual trace clock),
+    and the survivor-pool leak count. Everything except wall seconds is
+    structural — identical on any host."""
+    import hashlib
+
+    from picotron_tpu.config import ModelConfig, ServeConfig, resolve_preset
+    from picotron_tpu.models.llama import init_params
+    from picotron_tpu.resilience import chaos as chaos_mod
+    from picotron_tpu.serve import FleetSupervisor
+    from picotron_tpu.telemetry import JsonlSink, Telemetry
+
+    cap = prompt_len + max_new
+    preset = resolve_preset(model)
+    preset["max_position_embeddings"] = max(
+        preset.get("max_position_embeddings", 0), cap)
+    if layers:
+        preset["num_hidden_layers"] = layers
+    mcfg = ModelConfig(name=model, **preset)
+    params = jax.jit(
+        lambda k: jax.tree.map(lambda x: x.astype(jnp.bfloat16),
+                               init_params(mcfg, k)))(jax.random.key(0))
+    scfg = ServeConfig(decode_slots=slots, block_size=block_size,
+                       num_blocks=num_blocks, prefill_chunk=prefill_chunk,
+                       max_model_len=cap, decode_interval=decode_interval,
+                       fleet_size=fleet, deadline_ms=deadline_ms)
+    trace = make_serve_trace(n_requests, rate, prompt_len, max_new,
+                             mcfg.vocab_size, seed)
+
+    tel = None
+    if telemetry:
+        from picotron_tpu.telemetry.flightdeck import FlightRecorder
+
+        tel = Telemetry(sinks=[JsonlSink(telemetry)])
+        tel.flight = FlightRecorder(
+            os.path.dirname(os.path.abspath(telemetry)), max_steps=8)
+    if chaos_spec:
+        chaos_mod.install(chaos_spec)
+    try:
+        fl = FleetSupervisor(params, mcfg, scfg, temperature=temperature,
+                             seed=seed, telemetry=tel, tick_s=tick_s)
+        t0 = time.perf_counter()
+        results = fl.run(trace)
+        wall = time.perf_counter() - t0
+    finally:
+        if chaos_spec:
+            chaos_mod.install("")  # disarm: one spec, one run
+    summary = fl.summary
+    shed = fl.all_shed
+    digest = lambda toks: hashlib.sha1(  # noqa: E731
+        " ".join(map(str, toks)).encode()).hexdigest()[:16]
+    row = {
+        "metric": f"serve_fleet{fleet}_{model.split('/')[-1]}"
+                  f"-{mcfg.num_hidden_layers}L",
+        # headline: every admitted request finished — the recovery
+        # scenarios assert completed + shed == submitted even with an
+        # engine killed mid-burst
+        "value": len(results),
+        "unit": "completed_requests",
+        "fleet": fleet,
+        "requests": n_requests,
+        "completed": len(results),
+        "shed": len(shed),
+        "shed_ids": sorted(r["id"] for r in shed),
+        "redispatched": summary["redispatched"],
+        "engines_dead": summary["engines_dead"],
+        "drains": summary["drains"],
+        "leaked_blocks": summary["leaked_blocks"],
+        "output_tokens": summary["output_tokens"],
+        "wall_s": round(wall, 4),
+        "wall_note": _WALL_NOTE,
+        "arrival_rate": rate,
+        "temperature": temperature,
+        "deadline_ms": deadline_ms,
+        "chaos": chaos_spec or "",
+        "tick_s": tick_s,
+        "slots": slots,
+        "decode_steps": summary["decode_steps"],
+        "decode_compiles": summary["decode_compiles"],
+        "preemptions": summary["preemptions"],
+        "ttft_p50_ms": (round(summary["ttft_p50_s"] * 1e3, 2)
+                        if summary["ttft_p50_s"] is not None else None),
+        "ttft_p95_ms": (round(summary["ttft_p95_s"] * 1e3, 2)
+                        if summary["ttft_p95_s"] is not None else None),
+        "queue_wait_p50_ms": (
+            round(summary["queue_wait_p50_s"] * 1e3, 2)
+            if summary["queue_wait_p50_s"] is not None else None),
+        "queue_wait_p95_ms": (
+            round(summary["queue_wait_p95_s"] * 1e3, 2)
+            if summary["queue_wait_p95_s"] is not None else None),
+        "per_engine_requests": [pe["requests"]
+                                for pe in summary["per_engine"]],
+        # the parity pin: same trace + same seed must produce the same
+        # digest per id regardless of fleet size, failover, or shedding
+        # of OTHER requests
+        "request_digests": {str(r["id"]): digest(r["tokens"])
+                            for r in results},
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    fl.close()
+    if tel is not None:
+        tel.close()
+    return row
+
+
 def make_burst_trace(slots: int, prompt_len: int, prefill_chunk: int,
                      decode_interval: int, max_new: int, vocab: int,
                      seed: int = 0) -> list:
@@ -1133,6 +1250,32 @@ def main() -> None:
     ap.add_argument("--draft-lens", type=int, nargs="*", default=[1, 2, 3],
                     help="--serve --disagg: speculator draft lengths "
                          "for the acceptance sweep")
+    ap.add_argument("--fleet", type=int, default=0,
+                    help="--serve: run N engine replicas behind one "
+                         "queue (picotron_tpu/serve/fleet) instead of "
+                         "the vs-static comparison — failover "
+                         "re-dispatch, deadline shedding, per-request "
+                         "token digests for the parity oracle")
+    ap.add_argument("--chaos", metavar="SPEC", default=None,
+                    help="--serve --fleet: serve-side chaos spec "
+                         "(engine_dead@REQ, decode_hang@REQ~SECS, "
+                         "shed_storm@REQ[xN]; same grammar as "
+                         "resilience.chaos) injected in the fleet "
+                         "dispatch loop")
+    ap.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="--serve --fleet: per-request deadline on the "
+                         "virtual trace clock; a request still queued "
+                         "past it is shed (0 = no deadline)")
+    ap.add_argument("--serve-temperature", type=float, default=0.0,
+                    help="--serve --fleet: sampling temperature (keys "
+                         "fold per (request id, token index), so "
+                         "failover parity holds at any temperature)")
+    ap.add_argument("--tick-s", type=float, default=0.001,
+                    help="--serve --fleet: virtual trace-clock seconds "
+                         "per fleet iteration (what makes shed "
+                         "decisions deterministic)")
+    ap.add_argument("--serve-seed", type=int, default=0,
+                    help="--serve --fleet: trace + sampling seed")
     ap.add_argument("--pp-tick-sweep", action="store_true",
                     help="fit step time vs n_micro per pipeline executor "
                          "(SPMD lockstep scan vs MPMD per-stage programs) "
@@ -1223,6 +1366,23 @@ def main() -> None:
         if args.max_new_tokens < 1 or args.requests < 2:
             ap.error("--serve needs --max-new-tokens >= 1 and "
                      "--requests >= 2")
+        if args.fleet:
+            if args.disagg or args.tp > 1:
+                ap.error("--fleet places each replica on its own device; "
+                         "incompatible with --disagg/--tp in this bench "
+                         "mode")
+            print(json.dumps(run_serve_fleet(
+                args.model, args.layers or 0, fleet=args.fleet,
+                slots=args.serve_slots, block_size=args.block_size,
+                num_blocks=args.num_blocks,
+                prefill_chunk=args.prefill_chunk,
+                prompt_len=args.prompt_len,
+                max_new=args.max_new_tokens, n_requests=args.requests,
+                rate=args.rate, decode_interval=args.decode_interval,
+                seed=args.serve_seed, temperature=args.serve_temperature,
+                deadline_ms=args.deadline_ms, chaos_spec=args.chaos,
+                tick_s=args.tick_s, telemetry=args.telemetry)))
+            return
         if args.disagg:
             if args.tp > 1:
                 ap.error("--disagg places each pool on its own device; "

@@ -939,6 +939,25 @@ class ServeConfig:
     prefill_device: int = -1
     decode_device: int = -1
 
+    # --- fleet serving (serve/fleet.py): a FleetSupervisor fronting N
+    # engine replicas with health/failover/drain — the robustness layer
+    # in front of the single-engine stack ---
+    # Engine replicas behind the supervisor. 1 = no fleet (the
+    # single-engine paths are untouched). Each replica gets its own
+    # device (round-robin over jax.devices()), its own KV pool, and the
+    # SAME base sampling key, so failover re-dispatch is bit-identical.
+    fleet_size: int = 1
+    # Default admission deadline applied to requests that do not carry
+    # their own: a request still queued once its wait exceeds this many
+    # milliseconds is SHED (rejected, serve_shed event, booked to the
+    # `shed` ledger category) instead of admitted late. 0 = no deadline.
+    deadline_ms: float = 0.0
+    # Grace budget for FleetSupervisor.drain(): how long (trace-clock
+    # seconds) a draining engine may keep its residents before they are
+    # forcibly re-dispatched onto the survivors and the engine retires
+    # anyway.
+    drain_grace_s: float = 5.0
+
     # --- speculative decode (serve/spec_decode.py): multi-token decode
     # inside the decode_interval scan, verify-and-accept in one dispatch,
     # sampling keys still derived from (request id, token index) so
@@ -975,6 +994,17 @@ class ServeConfig:
                 raise ValueError(
                     f"serve.{name} must be a device index or -1 (auto), "
                     f"got {getattr(self, name)}")
+        if self.fleet_size < 1:
+            raise ValueError(
+                f"serve.fleet_size must be >= 1, got {self.fleet_size}")
+        if self.deadline_ms < 0:
+            raise ValueError(
+                f"serve.deadline_ms must be >= 0 (0 = no deadline), got "
+                f"{self.deadline_ms}")
+        if self.drain_grace_s < 0:
+            raise ValueError(
+                f"serve.drain_grace_s must be >= 0, got "
+                f"{self.drain_grace_s}")
         if self.speculator not in ("off", "ngram"):
             raise ValueError(
                 f"serve.speculator must be 'off' or 'ngram', got "
@@ -1168,6 +1198,31 @@ class Config:
                 "tokens through per-call capacity-bounded expert dispatch, "
                 "which is not parity-guaranteed against the offline "
                 "sampler; serve dense models only")
+        if self.serve.fleet_size > 1 and self.model.num_experts:
+            # Same root cause as the disagg guard above: every fleet
+            # replica chunk-prefills, and failover re-dispatch replays a
+            # request's prefix through a DIFFERENT chunking on the
+            # survivor — for MoE that changes routing, so the
+            # bit-identical-failover contract cannot hold.
+            raise ValueError(
+                "serve.fleet_size > 1 does not support MoE models "
+                "(model.num_experts > 0): failover re-dispatch replays "
+                "prefixes through per-call capacity-bounded expert "
+                "dispatch, which is not parity-guaranteed; serve dense "
+                "models only")
+        if self.serve.fleet_size > 1 and self.serve.speculator != "off":
+            # The n-gram drafter's context is engine-local state that a
+            # failover re-dispatch does not carry — tokens stay identical
+            # (verify-and-accept guarantees that) but the fleet's
+            # redispatch-latency and acceptance accounting would be
+            # engine-dependent; keep the combination a hard error until
+            # it is pinned.
+            raise ValueError(
+                "serve.fleet_size > 1 does not support speculative decode "
+                "(serve.speculator != 'off'): the drafter's context is "
+                "engine-local and is not carried across failover "
+                "re-dispatch; set serve.speculator='off' or "
+                "serve.fleet_size=1")
         d, m, t = self.distributed, self.model, self.training
         ck = self.checkpoint
         if ck.keep_last < 0 or ck.keep_every < 0:

@@ -40,9 +40,24 @@ A chaos spec is a comma-separated list of events, each
   ``#TICK`` never fires there, and a ``#TICK`` event never fires at
   step_begin.
 
+Serving faults key on the REQUEST id instead of the step number (the
+serve fleet's dispatch loop fires them with the request id in the STEP
+position — same grammar, different clock): ``engine_dead@REQ`` (the
+engine request REQ is being routed to, or decoding on, dies abruptly —
+state discarded wholesale, the in-process equivalent of SIGKILLing one
+replica; the FleetSupervisor catches `ChaosEngineDead` and re-dispatches
+the dead engine's residents onto survivors), ``decode_hang@REQ~SECS``
+(sleep SECS inside the decode dispatch path while request REQ is
+resident — exercises the serve watchdog, which names the hung
+``serve engine=K dispatch=decode`` and dumps a ``serve_hang``
+postmortem), and ``shed_storm@REQ`` (force the deadline shed decision
+for request REQ regardless of its actual queue wait; ``xCOUNT`` sheds
+the next COUNT routed requests — the overload-burst storm).
+
 Examples: ``sigterm@3``, ``ckpt_io@2x2,nan_grad@4``, ``data_stall@3~10``,
 ``ckpt_corrupt_bitflip@4,kill@5``, ``sigterm@3#2`` (mid-schedule),
-``hang@4~120#1``.
+``hang@4~120#1``, ``engine_dead@4``, ``decode_hang@2~5``,
+``shed_storm@6x3``.
 
 The spec comes from ``resilience.chaos`` in the config; the
 ``PICOTRON_CHAOS`` environment variable, when set (even to the empty
@@ -69,7 +84,28 @@ from typing import Optional
 
 KINDS = ("sigterm", "sigint", "kill", "slice_lost", "hang", "ckpt_io",
          "data_io", "data_stall", "nan_grad", "ckpt_corrupt_bitflip",
-         "ckpt_truncate", "ckpt_torn_meta")
+         "ckpt_truncate", "ckpt_torn_meta",
+         "engine_dead", "decode_hang", "shed_storm")
+
+
+class ChaosEngineDead(Exception):
+    """Raised by fire() at a serve point for `engine_dead`: the engine
+    handling this request dies abruptly. The FleetSupervisor catches it,
+    discards the engine's state wholesale (pool and all — nothing
+    graceful, the SIGKILL analogue for an in-process replica) and
+    re-dispatches its residents; anything else letting it propagate is a
+    bug, which is exactly what the chaos run would surface."""
+
+    def __init__(self, engine=None):
+        super().__init__(f"chaos: engine {engine} dead")
+        self.engine = engine
+
+
+class ChaosShed(Exception):
+    """Raised by fire() at the serve_route point for `shed_storm`: the
+    supervisor must shed this request as if its deadline were already
+    blown — the deterministic stand-in for a burst arriving faster than
+    admission can drain."""
 
 # Which event kinds an injection point can trigger. "nan_grad" has no fire
 # point: the driver reads nan_grad_steps() and routes those steps through
@@ -90,6 +126,14 @@ _POINT_KINDS = {
     "data_produce": ("data_io", "data_stall"),
     "ckpt_committed": ("ckpt_corrupt_bitflip", "ckpt_truncate",
                        "ckpt_torn_meta"),
+    # serve points fire with a REQUEST id in the step position (the
+    # serving clock is requests, not steps). serve_route: the fleet is
+    # routing request REQ to an engine (ctx: engine). serve_dispatch:
+    # an engine is about to run a decode dispatch with request REQ
+    # resident (ctx: engine) — the point a hang must hit for the
+    # watchdog to name the dispatch.
+    "serve_route": ("engine_dead", "shed_storm"),
+    "serve_dispatch": ("engine_dead", "decode_hang"),
 }
 
 # Kinds that may carry a #TICK suffix (the schedule_tick-capable set).
@@ -127,7 +171,7 @@ def parse_spec(spec: str) -> list[ChaosEvent]:
             raise ValueError(
                 f"unknown chaos kind {kind!r} in {item!r}; known: {KINDS}")
         secs = float(m.group("secs") or 0.0)
-        if kind in ("hang", "data_stall") and secs <= 0:
+        if kind in ("hang", "data_stall", "decode_hang") and secs <= 0:
             raise ValueError(
                 f"chaos event {item!r} needs a ~SECS duration (e.g. "
                 f"{kind}@{m.group('step')}~5)")
@@ -217,7 +261,16 @@ class ChaosController:
         by construction."""
         for e in self.events:
             if (e.kind not in _POINT_KINDS.get(point, ())
-                    or e.step != step or e.fired >= e.count):
+                    or e.fired >= e.count):
+                continue
+            if e.kind == "shed_storm":
+                # a STORM: fires on request REQ, then keeps firing on
+                # every subsequently routed request until its xCOUNT
+                # budget drains (the nan_grad budget arrangement) — one
+                # event sheds a contiguous run of arrivals.
+                if e.fired == 0 and e.step != step:
+                    continue
+            elif e.step != step:
                 continue
             if point == "schedule_tick":
                 if e.tick is None or ctx.get("tick") != e.tick:
@@ -227,11 +280,22 @@ class ChaosController:
             e.fired += 1
             where = (f" (stage={ctx.get('stage')} tick={ctx.get('tick')} "
                      f"op={ctx.get('op')} mb={ctx.get('mb')})"
-                     if point == "schedule_tick" else "")
-            _log(f"firing {e.kind} at {point} step {step}{where} "
+                     if point == "schedule_tick" else
+                     (f" (engine={ctx.get('engine')})"
+                      if point.startswith("serve_") else ""))
+            unit = "request" if point.startswith("serve_") else "step"
+            _log(f"firing {e.kind} at {point} {unit} {step}{where} "
                  f"({e.fired}/{e.count})")
-            _emit(e, point, step, **{k: v for k, v in ctx.items()
-                                     if k in ("tick", "stage", "op", "mb")})
+            _emit(e, point, step,
+                  **{k: v for k, v in ctx.items()
+                     if k in ("tick", "stage", "op", "mb", "engine")})
+            if e.kind == "engine_dead":
+                raise ChaosEngineDead(ctx.get("engine"))
+            if e.kind == "shed_storm":
+                raise ChaosShed(f"chaos: shed_storm at request {step}")
+            if e.kind == "decode_hang":
+                time.sleep(e.secs)
+                continue
             if e.kind in ("sigterm", "sigint"):
                 os.kill(os.getpid(),
                         signal.SIGTERM if e.kind == "sigterm"

@@ -49,10 +49,15 @@ def active() -> bool:
 class Watchdog:
     def __init__(self, timeout: float,
                  on_timeout: Optional[Callable[[], None]] = None,
-                 poll: Optional[float] = None):
+                 poll: Optional[float] = None,
+                 reason: str = "watchdog"):
         self.timeout = timeout
         self.enabled = timeout > 0
         self._on_timeout = on_timeout
+        # Flightdeck postmortem reason for a firing — the serve fleet
+        # arms with reason="serve_hang" so a hung decode dispatch is
+        # distinguishable from a wedged training step in the dump.
+        self.reason = reason
         self._poll = poll or max(0.05, min(timeout / 4.0, 1.0)) \
             if self.enabled else 1.0
         self._stop = threading.Event()
@@ -128,7 +133,7 @@ class Watchdog:
 
             tel = bus.active()
             if tel is not None and getattr(tel, "flight", None) is not None:
-                tel.flight.dump("watchdog", step=step, phase=phase,
+                tel.flight.dump(self.reason, step=step, phase=phase,
                                 stalled_s=round(age, 3))
         except Exception:  # noqa: BLE001 — the exit below must still happen
             pass

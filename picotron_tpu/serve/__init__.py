@@ -20,10 +20,14 @@ path — the "millions of users, heavy traffic" half of the north star.
 - `spec_decode`: speculative multi-token decode (self-drafting n-gram
   speculator, verify-and-accept in one dispatch) for either engine;
   token-identical to non-speculative decode by construction.
+- `fleet`: `FleetSupervisor` — N engine replicas behind one queue, with
+  failover re-dispatch (bit-identical continuations), deadline load
+  shedding, hang detection, and graceful drain.
 """
 
 from picotron_tpu.serve.disagg import DisaggServeEngine
 from picotron_tpu.serve.engine import ServeEngine
+from picotron_tpu.serve.fleet import FleetSupervisor
 from picotron_tpu.serve.paged_cache import (
     BlockPool, PagedKVCache, init_paged_cache,
 )
@@ -35,6 +39,7 @@ __all__ = [
     "BlockPool",
     "DisaggScheduler",
     "DisaggServeEngine",
+    "FleetSupervisor",
     "PagedKVCache",
     "Request",
     "Scheduler",

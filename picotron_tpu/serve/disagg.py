@@ -54,6 +54,7 @@ import numpy as np
 
 from picotron_tpu.config import ModelConfig, ServeConfig
 from picotron_tpu.models.llama import model_rope_tables
+from picotron_tpu.resilience import watchdog
 from picotron_tpu.serve.engine import ServeEngine, _get_jits
 from picotron_tpu.serve.paged_cache import BlockPool, init_paged_cache
 from picotron_tpu.serve.scheduler import DisaggScheduler, blocks_for
@@ -114,7 +115,8 @@ class DisaggServeEngine(ServeEngine):
                  serve_cfg: Optional[ServeConfig] = None, *,
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
-                 telemetry: Optional[Telemetry] = None):
+                 telemetry: Optional[Telemetry] = None,
+                 engine_id: int = 0):
         scfg = serve_cfg or ServeConfig()
         scfg.validate()
         if model_cfg.num_experts:
@@ -242,15 +244,17 @@ class DisaggServeEngine(ServeEngine):
         self._gather_jit, self._scatter_jit = _get_handoff_jits(donate)
 
         self._t0 = time.perf_counter()
+        self.engine_id = int(engine_id)
         self._decode_state: Optional[dict] = None
         self.results: list = []
+        self.shed_results: list = []
         self.stats = {
             "decode_steps": 0, "decode_compiles": 0,
             "prefill_chunks": 0, "occupancy_sum": 0.0,
             "prefill_occupancy_sum": 0.0, "prefill_ticks": 0,
             "output_tokens": 0, "prefill_tokens": 0,
             "draft_tokens": 0, "accepted_draft_tokens": 0,
-            "decode_stall_ticks_max": 0,
+            "decode_stall_ticks_max": 0, "cancelled": 0,
             "handoffs": 0, "handoff_s": 0.0, "handoff_blocks": 0,
         }
         self._stall_streak = 0
@@ -312,6 +316,8 @@ class DisaggServeEngine(ServeEngine):
                                 category="queue_wait", secs=wait,
                                 id=st.req.id)
             reg.histogram("serve/queue_wait").observe(wait)
+        for st in self.sched.drain_shed():
+            self._emit_shed(st, now)
 
         worked = False
 
@@ -337,6 +343,9 @@ class DisaggServeEngine(ServeEngine):
                     finals.append(s)
             up = partial(jax.device_put, device=self._sh_p)
             self._drain_compile()
+            if watchdog.active():
+                watchdog.touch(
+                    f"serve engine={self.engine_id} dispatch=prefill")
             t0 = time.perf_counter()
             self._k_p, self._v_p, toks_d = self._prefill_jit(
                 self.params_p, self._k_p, self._v_p, up(self._tables_p),

@@ -62,6 +62,7 @@ import numpy as np
 
 from picotron_tpu.config import ModelConfig, ServeConfig
 from picotron_tpu.generate import _decode_layers, _logits_last
+from picotron_tpu.resilience import watchdog
 from picotron_tpu.models.llama import (
     compute_dtype, final_hidden, head_weight, model_rope_tables,
 )
@@ -203,7 +204,8 @@ class ServeEngine:
                  serve_cfg: Optional[ServeConfig] = None, *,
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
-                 telemetry: Optional[Telemetry] = None):
+                 telemetry: Optional[Telemetry] = None,
+                 device=None, engine_id: int = 0):
         scfg = serve_cfg or ServeConfig()
         scfg.validate()
         if model_cfg.num_experts:
@@ -271,7 +273,12 @@ class ServeEngine:
                     else PartitionSpec())
                 break
         if self._rep_sh is None:
-            dev = jax.devices()[0]
+            # `device` pins the whole engine (params, KV pool, rope
+            # tables, key) to ONE device — the fleet's per-replica
+            # placement: N engines on N distinct (simulated) devices,
+            # each a self-contained replica whose state can be discarded
+            # wholesale on failover.
+            dev = device if device is not None else jax.devices()[0]
             self._rep_sh = jax.sharding.SingleDeviceSharding(dev)
             kv_sh = self._rep_sh
         self._k = jax.device_put(self._k, kv_sh)
@@ -304,16 +311,18 @@ class ServeEngine:
             self._decode_jit = get_spec_jit(jax.default_backend() != "cpu")
 
         self._t0 = time.perf_counter()  # trace clock zero (run() resets)
+        self.engine_id = int(engine_id)  # fleet replica index (0 = solo)
         # steady-state decode fast path: device-resident step inputs,
         # valid while the slot roster and block tables are unchanged
         self._decode_state: Optional[dict] = None
         self.results: list = []
+        self.shed_results: list = []
         self.stats = {
             "decode_steps": 0, "decode_compiles": 0,
             "prefill_chunks": 0, "occupancy_sum": 0.0,
             "output_tokens": 0, "prefill_tokens": 0,
             "draft_tokens": 0, "accepted_draft_tokens": 0,
-            "decode_stall_ticks_max": 0,
+            "decode_stall_ticks_max": 0, "cancelled": 0,
         }
         self._stall_streak = 0  # consecutive ticks: work queued, no decode
         self._next_auto_id = 0
@@ -337,13 +346,33 @@ class ServeEngine:
     # -- intake ------------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int,
-               req_id: Optional[int] = None, arrival: float = 0.0) -> int:
+               req_id: Optional[int] = None, arrival: float = 0.0,
+               deadline_ms: Optional[float] = None) -> int:
         if req_id is None:
             req_id = self._next_auto_id
         self._next_auto_id = max(self._next_auto_id, req_id + 1)
         self.sched.submit(Request(req_id, tuple(prompt), max_new_tokens,
-                                  arrival))
+                                  arrival, deadline_ms))
         return req_id
+
+    def cancel(self, request_id: int) -> bool:
+        """Abandon a request mid-generation (client hung up, upstream
+        timeout): its blocks go straight back to the pool and its slot
+        frees for the next admission — no result is recorded, nothing
+        leaks until teardown. Returns False for an unknown id (already
+        retired, shed, or never submitted)."""
+        got = self.sched.cancel(request_id)
+        if got is None:
+            return False
+        where, idx, st = got
+        if where == "slot":
+            self._sync_table(idx)
+        elif where == "pslot":  # disagg prefill side
+            self._sync_ptable(idx)
+        self.stats["cancelled"] += 1
+        self.telemetry.emit("serve_cancel", id=request_id, where=where,
+                            tokens=len(st.generated))
+        return True
 
     # -- helpers -----------------------------------------------------------
 
@@ -393,7 +422,24 @@ class ServeEngine:
             ttft_s=round(ttft, 6) if ttft is not None else None,
             latency_s=round(res["latency_s"], 6),
             tpot_s=round(tpot, 6) if tpot is not None else None,
-            preempted=st.n_preempted)
+            preempted=st.n_preempted, engine=self.engine_id)
+        return res
+
+    def _emit_shed(self, st, now: float) -> dict:
+        """Report one deadline-shed request: the queue seconds it burned
+        book to the `shed` ledger category (pure badput — the wait
+        bought nothing, the request never ran) and it lands in
+        `shed_results`, never `results` — shed requests are excluded
+        from goodput and throughput by construction."""
+        wait = max(now - st.req.arrival, 0.0)
+        res = {"id": st.req.id, "prompt_len": len(st.req.prompt),
+               "queue_wait_s": wait, "deadline_ms": st.req.deadline_ms,
+               "shed": True}
+        self.shed_results.append(res)
+        self.telemetry.emit("serve_shed", category="shed", secs=wait,
+                            id=st.req.id, deadline_ms=st.req.deadline_ms,
+                            queue_wait_s=round(wait, 6),
+                            engine=self.engine_id)
         return res
 
     # -- one engine iteration ---------------------------------------------
@@ -416,6 +462,8 @@ class ServeEngine:
                                 category="queue_wait", secs=wait,
                                 id=st.req.id)
             reg.histogram("serve/queue_wait").observe(wait)
+        for st in self.sched.drain_shed():
+            self._emit_shed(st, now)
 
         worked = False
 
@@ -442,6 +490,12 @@ class ServeEngine:
                     finals.append(s)
             up = partial(jax.device_put, device=self._rep_sh)
             self._drain_compile()
+            if watchdog.active():
+                # a hang inside this dispatch is reported as THIS
+                # dispatch, not a bare stack dump (satellite of the
+                # fleet's serve_hang detection; also arms bench --serve)
+                watchdog.touch(
+                    f"serve engine={self.engine_id} dispatch=prefill")
             t0 = time.perf_counter()
             self._k, self._v, toks_d = self._prefill_jit(
                 self.params, self._k, self._v, up(self._tables), up(ids),
@@ -551,6 +605,9 @@ class ServeEngine:
                         ds["ctx"] = up(context_rows(
                             self.sched.slots, active, self.num_slots))
                 self._drain_compile()
+                if watchdog.active():
+                    watchdog.touch(
+                        f"serve engine={self.engine_id} dispatch=decode")
                 t0 = time.perf_counter()
                 nval = None
                 if self.speculate:
@@ -637,24 +694,43 @@ class ServeEngine:
 
     # -- trace driver ------------------------------------------------------
 
-    def run(self, requests=()) -> list:
+    def run(self, requests=(), watchdog_timeout: float = 0.0) -> list:
         """Drive a whole trace: submit each (prompt, max_new_tokens[,
-        arrival]) when its arrival time passes on the trace clock, loop
-        engine steps until queue and slots drain. Returns per-request
-        result dicts sorted by request id."""
-        pending = sorted(requests, key=lambda r: r[2] if len(r) > 2 else 0.0)
-        self._t0 = t0 = time.perf_counter()
-        while pending or self.sched.has_work():
-            now = time.perf_counter() - t0
-            while pending and (pending[0][2] if len(pending[0]) > 2
-                               else 0.0) <= now:
-                r = pending.pop(0)
-                self.submit(r[0], r[1],
-                            arrival=r[2] if len(r) > 2 else 0.0)
-            if not self.sched.has_work():
-                time.sleep(min(max(pending[0][2] - now, 0.0), 0.01))
-                continue
-            self.step(now)
+        arrival[, deadline_ms]]) when its arrival time passes on the
+        trace clock, loop engine steps until queue and slots drain.
+        Returns per-request result dicts sorted by request id (shed
+        requests are in `self.shed_results`, not here).
+
+        watchdog_timeout > 0 arms a resilience watchdog for the trace:
+        every dispatch heartbeats with a phase naming this engine and
+        dispatch kind, so a wedged device call is reported as `serve
+        engine=K dispatch=decode` — flightdeck postmortem reason
+        `serve_hang`, then exit 77 for the supervisor (same contract as
+        a hung training collective)."""
+        wd = None
+        if watchdog_timeout > 0:
+            from picotron_tpu.resilience.watchdog import Watchdog
+            wd = Watchdog(watchdog_timeout, reason="serve_hang")
+            wd.start()
+        try:
+            pending = sorted(requests,
+                             key=lambda r: r[2] if len(r) > 2 else 0.0)
+            self._t0 = t0 = time.perf_counter()
+            while pending or self.sched.has_work():
+                now = time.perf_counter() - t0
+                while pending and (pending[0][2] if len(pending[0]) > 2
+                                   else 0.0) <= now:
+                    r = pending.pop(0)
+                    self.submit(r[0], r[1],
+                                arrival=r[2] if len(r) > 2 else 0.0,
+                                deadline_ms=r[3] if len(r) > 3 else None)
+                if not self.sched.has_work():
+                    time.sleep(min(max(pending[0][2] - now, 0.0), 0.01))
+                    continue
+                self.step(now)
+        finally:
+            if wd is not None:
+                wd.stop()
         self._emit_summary(time.perf_counter() - t0)
         return sorted(self.results, key=lambda r: r["id"])
 
@@ -697,6 +773,8 @@ class ServeEngine:
                 round(self.stats["accepted_draft_tokens"] / drafted, 4)
                 if drafted else None),
             "preemptions": self.sched.n_preempted,
+            "shed": self.sched.n_shed,
+            "cancelled": self.stats["cancelled"],
             "slots": self.num_slots,
             "num_blocks": self.num_blocks,
             "block_size": self.block_size,

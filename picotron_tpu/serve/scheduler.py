@@ -35,12 +35,18 @@ from typing import Optional
 @dataclass(frozen=True)
 class Request:
     """One generation request. `arrival` is seconds on the trace clock
-    (bench.py --serve replays synthetic arrival times against it)."""
+    (bench.py --serve replays synthetic arrival times against it).
+    `deadline_ms`, when set, is an ADMISSION deadline: a request still
+    queued once its wait exceeds it is shed (rejected, never run) rather
+    than admitted late — the load-shedding contract that keeps an
+    overload burst from degrading every admitted request's TTFT. None =
+    wait forever (the pre-fleet behavior)."""
 
     id: int
     prompt: tuple
     max_new_tokens: int
     arrival: float = 0.0
+    deadline_ms: Optional[float] = None
 
     def __post_init__(self):
         object.__setattr__(self, "prompt", tuple(int(t) for t in self.prompt))
@@ -49,6 +55,10 @@ class Request:
         if self.max_new_tokens < 1:
             raise ValueError(
                 f"request {self.id}: max_new_tokens must be >= 1")
+        if self.deadline_ms is not None and self.deadline_ms <= 0:
+            raise ValueError(
+                f"request {self.id}: deadline_ms must be > 0 (None = no "
+                f"deadline), got {self.deadline_ms}")
 
 
 @dataclass
@@ -100,6 +110,11 @@ class Scheduler:
         self.n_admitted = 0
         self.n_preempted = 0
         self.n_retired = 0
+        self.n_shed = 0
+        self.n_cancelled = 0
+        # shed-but-not-yet-reported states; the engine drains this after
+        # each admit() and emits the serve_shed telemetry per entry
+        self.shed: list = []
 
     # -- intake ------------------------------------------------------------
 
@@ -127,12 +142,37 @@ class Scheduler:
 
     # -- admission ---------------------------------------------------------
 
+    def _shed_expired_head(self, now: float) -> bool:
+        """Deadline admission: a head whose queue-wait already exceeds
+        its deadline is REJECTED (popped into `self.shed`, never run) —
+        decided here, at the admission attempt, so the shed set is a
+        pure function of the trace clock and the queue order (no wall
+        time, no races: the determinism the overload tests pin). Only
+        the head is examined — head-of-line FIFO discipline holds for
+        shedding exactly as it does for admission."""
+        st = self.queue[0]
+        dl = st.req.deadline_ms
+        if dl is None or (now - st.req.arrival) * 1e3 <= dl:
+            return False
+        self.queue.popleft()
+        self.shed.append(st)
+        self.n_shed += 1
+        return True
+
+    def drain_shed(self) -> list:
+        out, self.shed = self.shed, []
+        return out
+
     def admit(self, now: float = 0.0) -> list:
         """Head-of-line FIFO admission while a slot is free and the pool
         covers the head's whole prefill prefix. Returns the (slot_index,
-        RequestState) pairs admitted this call."""
+        RequestState) pairs admitted this call. Heads past their
+        deadline are shed (even when every slot is busy — the queue must
+        not back up behind the already-dead)."""
         out = []
         while self.queue:
+            if self._shed_expired_head(now):
+                continue
             free = [i for i, s in enumerate(self.slots) if s is None]
             if not free:
                 break
@@ -224,6 +264,32 @@ class Scheduler:
         self.queue.appendleft(st)  # front: it keeps its arrival priority
         self.n_preempted += 1
 
+    # -- cancellation ------------------------------------------------------
+
+    def cancel(self, request_id: int):
+        """Abandon a request wherever it lives — decode slot or queue —
+        freeing any blocks it holds straight back to the pool (the
+        no-leak contract: before this existed the only way to drop a
+        request was engine teardown). Returns ("slot", index, state) or
+        ("queue", None, state), or None when the id is unknown (already
+        retired, shed, or never submitted)."""
+        for i, s in enumerate(self.slots):
+            if s is not None and s.req.id == request_id:
+                self.pool.free(s.blocks)
+                s.blocks = []
+                self.slots[i] = None
+                self.n_cancelled += 1
+                return "slot", i, s
+        for s in list(self.queue):
+            if s.req.id == request_id:
+                self.queue.remove(s)
+                if s.blocks:  # queued states hold no blocks; defensive
+                    self.pool.free(s.blocks)
+                    s.blocks = []
+                self.n_cancelled += 1
+                return "queue", None, s
+        return None
+
     # -- retirement --------------------------------------------------------
 
     def should_retire(self, slot: int, eos_token_id: Optional[int]) -> bool:
@@ -286,6 +352,26 @@ class DisaggScheduler:
         self.n_preempted = 0
         self.n_retired = 0
         self.n_handoffs = 0
+        self.n_shed = 0
+        self.n_cancelled = 0
+        self.shed: list = []
+
+    # deadline shedding is a queue-head policy, identical on both sides
+    # of the disaggregation split — share the colocated implementation
+    _shed_expired_head = Scheduler._shed_expired_head
+    drain_shed = Scheduler.drain_shed
+
+    def cancel(self, request_id: int):
+        """Scheduler.cancel plus the prefill side: a request caught
+        mid-prefill frees back to the PREFILL pool."""
+        for i, s in enumerate(self.pslots):
+            if s is not None and s.req.id == request_id:
+                self.prefill_pool.free(s.blocks)
+                s.blocks = []
+                self.pslots[i] = None
+                self.n_cancelled += 1
+                return "pslot", i, s
+        return Scheduler.cancel(self, request_id)
 
     # -- intake ------------------------------------------------------------
 
@@ -329,6 +415,8 @@ class DisaggScheduler:
         when the prefix ends block-aligned)."""
         out = []
         while self.queue:
+            if self._shed_expired_head(now):
+                continue
             free = [i for i, s in enumerate(self.pslots) if s is None]
             if not free:
                 break

@@ -40,6 +40,14 @@ Every timed second of the run is booked to exactly one category:
                      boundary. Transport overhead, NOT goodput — the
                      number the cost model's price_kv_handoff predicts
                      and the decode pool must never wait on.
+- ``shed``         — serving only (serve/fleet.py deadline admission):
+                     queue seconds burned by requests REJECTED because
+                     their wait already exceeded their deadline. Pure
+                     badput — the time bought nothing, the request never
+                     ran — booked apart from queue_wait (which admitted
+                     requests recover by finishing) so an overload run's
+                     report shows exactly what the load shedder threw
+                     away.
 
 The per-phase -> category mapping is shared with tools/telemetry_report.py
 (PHASE_CATEGORY) so in-process booking and post-hoc JSONL analysis can
@@ -82,9 +90,10 @@ CATEGORIES = (
     "retry_backoff", "data_wait", "host_sync", "pp_bubble", "eval",
     "other",
     # serving (picotron_tpu/serve): device time in the two jitted
-    # programs (goodput), the admission-latency badput, and the
-    # disaggregated engines' cross-pool KV transfer (badput: transport)
-    "prefill", "decode", "queue_wait", "handoff",
+    # programs (goodput), the admission-latency badput, the
+    # disaggregated engines' cross-pool KV transfer (badput: transport),
+    # and queue seconds thrown away by deadline load shedding (badput)
+    "prefill", "decode", "queue_wait", "handoff", "shed",
 )
 
 

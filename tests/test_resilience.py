@@ -651,7 +651,8 @@ def test_chaos_cli_lists_every_scenario(capsys):
     out = capsys.readouterr().out
     for name in ("sigterm", "ckpt_io", "nan_skip", "nan_rollback",
                  "data_stall", "ckpt_corrupt_bitflip", "dp_resize",
-                 "pp_resize", "slice_lost", "mpmd_sigterm"):
+                 "pp_resize", "slice_lost", "mpmd_sigterm",
+                 "serve_engine_dead", "serve_overload"):
         assert name in out
 
 
@@ -870,3 +871,95 @@ def test_chaos_mpmd_sigterm_scenario(tmp_path):
     assert s["steps"]["count"] == cli.STEPS
     assert s["steps"]["max"] == cli.STEPS
     assert s["steps"]["replayed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# serve-side chaos (PR 20): grammar + firing semantics + full scenarios
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_serve_grammar_and_points():
+    """The serving kinds parse under the unchanged KIND@STEP[xCOUNT]
+    [~SECS] grammar (the step position carries the REQUEST id), are
+    registered in KINDS and the module docstring, and are admitted only
+    at their serve points: storms at routing, hangs at dispatch, engine
+    death at both."""
+    evs = chaos.parse_spec("engine_dead@4,decode_hang@2~0.5,"
+                           "shed_storm@6x3")
+    assert [(e.kind, e.step, e.count, e.secs) for e in evs] == [
+        ("engine_dead", 4, 1, 0.0), ("decode_hang", 2, 1, 0.5),
+        ("shed_storm", 6, 3, 0.0)]
+    for kind in ("engine_dead", "decode_hang", "shed_storm"):
+        assert kind in chaos.KINDS
+        assert kind in chaos.__doc__
+    assert chaos._POINT_KINDS["serve_route"] == (
+        "engine_dead", "shed_storm")
+    assert chaos._POINT_KINDS["serve_dispatch"] == (
+        "engine_dead", "decode_hang")
+    with pytest.raises(ValueError, match="~SECS"):
+        chaos.parse_spec("decode_hang@2")  # a hang needs a duration
+
+
+def test_chaos_engine_dead_raises_with_engine_ctx():
+    """engine_dead raises ChaosEngineDead carrying the engine id from
+    the firing context — at either serve point, once per budget."""
+    ctrl = chaos.ChaosController(chaos.parse_spec("engine_dead@5"))
+    ctrl.fire("serve_route", 4, engine=1)  # wrong request: inert
+    with pytest.raises(chaos.ChaosEngineDead) as ei:
+        ctrl.fire("serve_route", 5, engine=1)
+    assert ei.value.engine == 1
+    ctrl.fire("serve_route", 5, engine=1)  # budget of 1: exhausted
+
+    ctrl = chaos.ChaosController(chaos.parse_spec("engine_dead@7"))
+    with pytest.raises(chaos.ChaosEngineDead) as ei:
+        ctrl.fire("serve_dispatch", 7, engine=0)
+    assert ei.value.engine == 0
+
+
+def test_chaos_decode_hang_sleeps_inside_dispatch():
+    ctrl = chaos.ChaosController(chaos.parse_spec("decode_hang@2~0.05"))
+    t0 = time.monotonic()
+    ctrl.fire("serve_dispatch", 2, engine=0)
+    assert time.monotonic() - t0 >= 0.05
+    t0 = time.monotonic()
+    ctrl.fire("serve_dispatch", 2, engine=0)  # budget drained: no sleep
+    assert time.monotonic() - t0 < 0.05
+
+
+def test_chaos_shed_storm_budget_is_consecutive():
+    """shed_storm@REQxN is a STORM: it arms on request REQ and then
+    sheds every subsequently routed request until the xCOUNT budget
+    drains — one event models a contiguous overload burst."""
+    ctrl = chaos.ChaosController(chaos.parse_spec("shed_storm@6x3"))
+    ctrl.fire("serve_route", 5, engine=0)  # before REQ: inert
+    for rid in (6, 7, 8):
+        with pytest.raises(chaos.ChaosShed):
+            ctrl.fire("serve_route", rid, engine=0)
+    ctrl.fire("serve_route", 9, engine=0)  # budget drained
+    # storms exist only at routing, never inside a dispatch
+    ctrl = chaos.ChaosController(chaos.parse_spec("shed_storm@6"))
+    ctrl.fire("serve_dispatch", 6, engine=0)
+
+
+@pytest.mark.slow
+def test_chaos_serve_engine_dead_scenario(tmp_path):
+    """Fleet failover, the full subprocess scenario: bench --serve
+    --fleet 2 with engine_dead@2 kills a replica mid-burst; the runner
+    asserts all 8 requests finish with per-request token digests
+    bit-identical to the fleet-of-1 oracle at temperature 0.7, at least
+    one re-dispatch, zero leaked blocks, a serve_engine_dead flightdeck
+    postmortem, and digest-exact determinism across a repeat leg."""
+    cli = _load_chaos_cli()
+    assert cli.run_serve_engine_dead(str(tmp_path))
+
+
+@pytest.mark.slow
+def test_chaos_serve_overload_scenario(tmp_path):
+    """Deadline load shedding, the full subprocess scenario: a 1-slot
+    engine under a 10-request burst with deadline_ms=6 sheds the tail
+    deterministically (same shed ids on a repeat leg), the admitted
+    requests' digests match the no-deadline leg, queue-wait p95 stays
+    within the deadline, and telemetry_report books the shed seconds
+    under the `shed` badput category and renders the serving view."""
+    cli = _load_chaos_cli()
+    assert cli.run_serve_overload(str(tmp_path))

@@ -476,3 +476,81 @@ def test_bench_serve_trace_deterministic():
     assert a == b
     assert all(t1 <= t2 for (_, _, t1), (_, _, t2) in zip(a, a[1:]))
     assert {len(p) for p, _, _ in a} != {32}  # mixed prompt lengths
+
+
+# ---------------------------------------------------------------------------
+# cancel + deadline shed (PR 20 satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_sheds_expired_heads_even_when_slots_busy():
+    """Deadline admission is pure host logic on the trace clock: a head
+    whose queue wait exceeds its deadline_ms is rejected at the admission
+    attempt — even when every slot is busy, so the queue cannot back up
+    behind the already-dead. No deadline means never shed."""
+    s = make_sched(slots=1, blocks=8, bs=4)
+    s.submit(Request(0, (1,) * 4, 4, 0.0))            # no deadline
+    s.submit(Request(1, (1,) * 4, 4, 0.0, 10.0))      # 10 ms budget
+    s.submit(Request(2, (1,) * 4, 4, 0.0, 50.0))      # 50 ms budget
+    admitted = s.admit(0.0)
+    assert [st.req.id for _, st in admitted] == [0]
+    # 20 ms later: 1 is past its deadline and sheds despite the busy
+    # slot; 2 is still inside its budget and stays queued
+    assert s.admit(0.020) == []
+    assert s.n_shed == 1
+    assert [st.req.id for st in s.drain_shed()] == [1]
+    assert s.drain_shed() == []
+    assert [st.req.id for st in s.queue] == [2]
+    s.admit(0.060)
+    assert s.n_shed == 2
+    assert [st.req.id for st in s.drain_shed()] == [2]
+
+
+def test_engine_cancel_resident_and_queued_no_leak(tiny, requests5,
+                                                   offline_refs):
+    """ServeEngine.cancel abandons a request wherever it lives: a
+    mid-decode resident frees its blocks back to the pool immediately,
+    a queued request just vanishes; neither leaves a result, neither
+    leaks a block, and the survivors' greedy tokens are untouched by
+    the batch-composition change (ragged-batch invariance)."""
+    from picotron_tpu.telemetry import Telemetry
+
+    class _Cap:
+        def __init__(self):
+            self.events = []
+
+        def emit(self, e):
+            self.events.append(e)
+
+        def close(self):
+            pass
+
+    cap = _Cap()
+    cfg, params = tiny
+    eng = ServeEngine(params, cfg, scfg(decode_slots=2),
+                      telemetry=Telemetry(sinks=[cap]))
+    for p, n in requests5:
+        eng.submit(p, n)
+    eng.step(0.0)
+    eng.step(0.0)  # residents are mid-prefill/decode, not just admitted
+    resident = [s.req.id for s in eng.sched.slots if s is not None]
+    queued = [s.req.id for s in eng.sched.queue]
+    assert resident and queued
+    held = eng.pool.in_use
+    v_slot, v_queue = resident[0], queued[0]
+    assert eng.cancel(v_slot)
+    assert eng.pool.in_use < held      # blocks back in the pool NOW
+    assert eng.cancel(v_queue)
+    assert not eng.cancel(v_slot)      # already gone: unknown id
+    assert eng.stats["cancelled"] == 2
+    while eng.sched.has_work():
+        eng.step()
+    assert eng.pool.in_use == 0        # no leak without teardown
+    done = {r["id"]: r["tokens"] for r in eng.results}
+    assert set(done) == set(range(len(requests5))) - {v_slot, v_queue}
+    for rid, toks in done.items():
+        assert toks == offline_refs[rid], rid
+    cancels = [e for e in cap.events if e.get("kind") == "serve_cancel"]
+    assert sorted(e["id"] for e in cancels) == sorted([v_slot, v_queue])
+    assert {e["where"] for e in cancels} == {"slot", "queue"}
+    eng.close()

@@ -48,6 +48,21 @@ Scenarios (the runtime-failure matrix README "Fault tolerance" documents):
                 step boundary (emergency ckpt, exit 75, zero replayed
                 steps on resume); a forced mid-schedule hang is
                 watchdog-reported naming the live (stage, tick, op)
+  serve_engine_dead
+                kill 1 of 2 serving replicas mid-burst (chaos
+                engine_dead@REQ in the fleet dispatch loop): the
+                survivor finishes EVERY request with tokens
+                bit-identical to a fault-free single-engine oracle
+                (temperature > 0 — the sampling-key fold is the
+                mechanism), zero leaked blocks on the survivor pool, a
+                serve_engine_dead postmortem, deterministic on repeat
+  serve_overload
+                burst a 1-slot engine with deadline'd requests: the
+                shed set is a deterministic function of the trace
+                (virtual clock), admitted requests' tokens match the
+                no-deadline run bit-for-bit, every admitted queue wait
+                respects the deadline, and the shed seconds land in
+                the telemetry ledger's `shed` (badput) category
 
 Usage:
 
@@ -835,6 +850,198 @@ def _doctor_flags_exactly(save_dir: str, corrupt_step: int):
     return None
 
 
+def _run_bench_fleet(leg_dir: str, extra_args: list,
+                     telemetry: str | None = None) -> dict:
+    """One `bench.py --serve --fleet` leg in a subprocess (2 simulated
+    CPU devices, so replicas really live on distinct devices); returns
+    the bench JSON row."""
+    os.makedirs(leg_dir, exist_ok=True)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PICOTRON_PREFLIGHT"] = "0"
+    env.pop("PICOTRON_CHAOS", None)  # the leg's --chaos is the only fault
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "bench.py"),
+           "--serve", "--model", "debug-tiny", "--prompt-len", "16",
+           "--max-new-tokens", "8", "--serve-slots", "3", "--block-size",
+           "4", "--prefill-chunk", "4", "--serve-temperature", "0.7",
+           "--serve-seed", "7"] + extra_args
+    if telemetry:
+        cmd += ["--telemetry", telemetry]
+    log_path = os.path.join(leg_dir, "run.log")
+    with open(log_path, "ab") as log:
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE, stderr=log,
+                              env=env, timeout=600)
+    with open(log_path, "ab") as log:
+        log.write(proc.stdout)
+    if proc.returncode != 0:
+        raise RuntimeError(f"bench fleet leg exited {proc.returncode} "
+                           f"(log: {log_path})")
+    for line in reversed(proc.stdout.decode().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(f"no JSON row in bench output (log: {log_path})")
+
+
+def run_serve_engine_dead(workdir: str, verbose: bool = False) -> bool:
+    """Engine failover under load — the serving half of the fault matrix.
+
+    Oracle leg: fleet of 1, no faults, temperature 0.7. Fault leg: fleet
+    of 2 with `engine_dead@2` fired in the dispatch loop — engine killed
+    abruptly (state discarded wholesale) while requests are resident.
+    The survivor must finish EVERY request with per-request token
+    digests IDENTICAL to the oracle's (the (request id, token index)
+    sampling-key fold makes re-dispatched continuations bit-exact at any
+    temperature), show zero leaked blocks, and leave a
+    serve_engine_dead flightdeck postmortem. A repeat of the fault leg
+    must reproduce the digests exactly — recovery is deterministic, not
+    merely successful."""
+    fail = lambda msg: (print(f"[chaos-cli] serve_engine_dead: FAIL — "  # noqa: E731
+                              f"{msg}"), False)[1]
+    n_req = 8
+    common = ["--requests", str(n_req)]
+
+    oracle = _run_bench_fleet(os.path.join(workdir, "oracle"),
+                              common + ["--fleet", "1"])
+    tel_dir = os.path.join(workdir, "fault")
+    fault = _run_bench_fleet(
+        tel_dir, common + ["--fleet", "2", "--chaos", "engine_dead@2"],
+        telemetry=os.path.join(tel_dir, "telemetry.jsonl"))
+    if verbose:
+        print(json.dumps(oracle), "\n", json.dumps(fault))
+
+    if fault["engines_dead"] != 1:
+        return fail(f"engines_dead {fault['engines_dead']} != 1 — the "
+                    f"chaos kill did not land")
+    if fault["completed"] != n_req or fault["shed"]:
+        return fail(f"survivor finished {fault['completed']}/{n_req} "
+                    f"(shed {fault['shed']}) — every request must "
+                    f"complete on the surviving engine")
+    if fault["redispatched"] < 1:
+        return fail("no requests were re-dispatched — the engine died "
+                    "with nothing in flight, so the scenario proved "
+                    "nothing")
+    if fault["request_digests"] != oracle["request_digests"]:
+        bad = [k for k, v in oracle["request_digests"].items()
+               if fault["request_digests"].get(k) != v]
+        return fail(f"token parity broken after failover for request(s) "
+                    f"{bad} — re-dispatched continuations must be "
+                    f"bit-identical to the fault-free oracle")
+    if fault["leaked_blocks"]:
+        return fail(f"{fault['leaked_blocks']} leaked block(s) on "
+                    f"survivor pools after the trace drained")
+
+    pm_path = os.path.join(tel_dir, "flightdeck_postmortem.json")
+    if not os.path.exists(pm_path):
+        return fail(f"no flightdeck postmortem at {pm_path}")
+    with open(pm_path) as f:
+        pm = json.load(f)
+    if pm.get("reason") != "serve_engine_dead":
+        return fail(f"postmortem reason {pm.get('reason')!r} != "
+                    f"'serve_engine_dead'")
+
+    repeat = _run_bench_fleet(
+        os.path.join(workdir, "repeat"),
+        common + ["--fleet", "2", "--chaos", "engine_dead@2"])
+    if repeat["request_digests"] != fault["request_digests"] \
+            or repeat["redispatched"] != fault["redispatched"]:
+        return fail("fault leg is not deterministic across repeats "
+                    "(digests or redispatch count changed)")
+
+    dead_engine = (pm.get("extra") or {}).get("engine")
+    print(f"[chaos-cli] serve_engine_dead: OK — engine killed mid-burst "
+          f"(postmortem engine {dead_engine}), survivor finished "
+          f"{fault['completed']}/{n_req} requests bit-identical to the "
+          f"single-engine oracle ({fault['redispatched']} re-dispatched), "
+          f"0 leaked blocks, deterministic on repeat")
+    return True
+
+
+def run_serve_overload(workdir: str, verbose: bool = False) -> bool:
+    """Deadline load shedding under a saturation burst.
+
+    Both legs run a 1-slot engine on the same all-at-t=0 burst (10
+    requests into one decode slot — a 10x overload). The no-deadline leg
+    serves everything late; the deadline leg sheds the requests whose
+    VIRTUAL-clock queue wait exceeds --deadline-ms. Pins: the shed set
+    is non-empty and identical across repeats (the shed decision is a
+    pure function of the trace), admitted requests' token digests match
+    the no-deadline leg bit-for-bit (shedding neighbors must not perturb
+    sampling), every admitted queue wait respects the deadline (the
+    graceful-degradation SLO), and the shed seconds are booked to the
+    telemetry ledger's `shed` category, rendered by telemetry_report."""
+    fail = lambda msg: (print(f"[chaos-cli] serve_overload: FAIL — "  # noqa: E731
+                              f"{msg}"), False)[1]
+    n_req = 10
+    deadline_ms = 6.0
+    burst = ["--requests", str(n_req), "--serve-slots", "1",
+             "--rate", "0"]
+
+    unloaded = _run_bench_fleet(os.path.join(workdir, "no_deadline"),
+                                burst + ["--fleet", "1"])
+    tel_dir = os.path.join(workdir, "deadline")
+    tel_path = os.path.join(tel_dir, "telemetry.jsonl")
+    shedleg = _run_bench_fleet(
+        tel_dir,
+        burst + ["--fleet", "1", "--deadline-ms", str(deadline_ms)],
+        telemetry=tel_path)
+    if verbose:
+        print(json.dumps(unloaded), "\n", json.dumps(shedleg))
+
+    if not shedleg["shed"]:
+        return fail("burst shed nothing — the overload never tripped "
+                    "the deadline, scenario proves nothing")
+    if shedleg["completed"] + shedleg["shed"] != n_req:
+        return fail(f"completed {shedleg['completed']} + shed "
+                    f"{shedleg['shed']} != {n_req} submitted")
+    admitted = {k: v for k, v in shedleg["request_digests"].items()}
+    mismatch = [k for k, v in admitted.items()
+                if unloaded["request_digests"].get(k) != v]
+    if mismatch:
+        return fail(f"admitted request(s) {mismatch} decoded different "
+                    f"tokens than the no-deadline leg — shedding "
+                    f"neighbors must not perturb sampling")
+    qw95 = shedleg["queue_wait_p95_ms"]
+    if qw95 is None or qw95 > deadline_ms + 1e-6:
+        return fail(f"admitted queue wait p95 {qw95} ms exceeds the "
+                    f"{deadline_ms} ms deadline — admission let an "
+                    f"expired request through")
+
+    repeat = _run_bench_fleet(
+        os.path.join(workdir, "repeat"),
+        burst + ["--fleet", "1", "--deadline-ms", str(deadline_ms)])
+    if repeat["shed_ids"] != shedleg["shed_ids"] \
+            or repeat["request_digests"] != shedleg["request_digests"]:
+        return fail(f"shed set not deterministic: {shedleg['shed_ids']} "
+                    f"vs {repeat['shed_ids']} on repeat")
+
+    import telemetry_report
+
+    summary = telemetry_report.summarize(
+        telemetry_report.load_events(tel_path))
+    shed_s = (summary.get("categories") or {}).get("shed", 0.0)
+    if not shed_s > 0.0:
+        return fail("no seconds booked to the `shed` ledger category in "
+                    "the telemetry stream")
+    sv = summary.get("serving") or {}
+    if sv.get("shed") != shedleg["shed"]:
+        return fail(f"telemetry_report serving view shed {sv.get('shed')} "
+                    f"!= bench row {shedleg['shed']}")
+    if "shed" not in telemetry_report.render(summary):
+        return fail("telemetry_report render does not show the shed row")
+
+    print(f"[chaos-cli] serve_overload: OK — burst shed "
+          f"{shedleg['shed']}/{n_req} deterministically "
+          f"(ids {shedleg['shed_ids']}), admitted tokens bit-identical "
+          f"to the no-deadline leg, queue wait p95 {qw95} ms <= "
+          f"{deadline_ms} ms deadline, {round(shed_s, 4)}s booked to "
+          f"`shed`")
+    return True
+
+
 def _postmortem_matches(save_dir: str, reason: str, fault_step: int):
     """The flightdeck flight recorder (telemetry/flightdeck/flight.py)
     must have left a postmortem dump next to the checkpoints whose
@@ -1002,6 +1209,18 @@ CUSTOM_SCENARIOS: dict[str, tuple[Callable, str]] = {
                      "(stage, tick, op) drains to the step boundary "
                      "(exit 75, zero replayed steps on resume); forced "
                      "hang is watchdog-reported naming the live op"),
+    "serve_engine_dead": (run_serve_engine_dead,
+                          "kill 1 of 2 serving replicas mid-burst: the "
+                          "survivor finishes every request bit-identical "
+                          "to the single-engine oracle (temp 0.7), zero "
+                          "leaked blocks, serve_engine_dead postmortem, "
+                          "deterministic on repeat"),
+    "serve_overload": (run_serve_overload,
+                       "deadline shedding under a 10x burst: "
+                       "deterministic shed set, admitted tokens match "
+                       "the no-deadline leg bit-for-bit, queue wait p95 "
+                       "within the deadline, shed seconds booked to the "
+                       "`shed` ledger category"),
 }
 
 

@@ -112,6 +112,11 @@ _SERVE_FIELDS = (
     ("acceptance_rate", "serve_acceptance_rate", 1),
     ("decode_stall_ticks_max", "serve_decode_stall_ticks_max", 1),
     ("handoffs", "serve_handoffs", 1),
+    # fleet serving (serve/fleet.py): overload + failover counters
+    ("shed", "serve_shed", 1),
+    ("redispatched", "serve_redispatch", 1),
+    ("engines_dead", "serve_engines_dead", 1),
+    ("fleet_size", "serve_fleet_size", 1),
 )
 
 

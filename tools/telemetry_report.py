@@ -172,7 +172,7 @@ def summarize(events: list[dict]) -> dict:
         out["training"] = out.get("training", {})
         out["training"]["final_val_loss"] = eval_rows[-1].get("val_loss")
     if serve_reqs or serve_summary:
-        out["serving"] = serving_view(serve_reqs, serve_summary)
+        out["serving"] = serving_view(serve_reqs, serve_summary, counts)
     # Elastic-resize row: the resize category already sums into the table
     # above (the phase event carries its resolved category); this pairs
     # the seconds with the elastic_resize events so a shrink/grow saga is
@@ -233,12 +233,16 @@ def pipeline_view(categories: dict[str, float],
     return view
 
 
-def serving_view(reqs: list[dict], summary: dict | None) -> dict:
+def serving_view(reqs: list[dict], summary: dict | None,
+                 counts: dict | None = None) -> dict:
     """SLO view of a serving stream: per-request TTFT/queue-wait
     percentiles recomputed from the serve_request events (so the view
     works even on a stream truncated before its serve_summary), plus the
     engine-level aggregates (tok/s, per-token latency, slot occupancy,
-    pool utilization) from the serve_summary when present."""
+    pool utilization) from the serve_summary when present. Fleet runs
+    (serve/fleet.py) add shed/redispatch/engine-death counters and
+    per-engine rows; on a truncated stream those fall back to counting
+    the serve_shed / serve_redispatch events directly."""
     view: dict = {"requests": len(reqs)}
     ttfts = [r["ttft_s"] for r in reqs
              if isinstance(r.get("ttft_s"), (int, float))]
@@ -278,12 +282,30 @@ def serving_view(reqs: list[dict], summary: dict | None) -> dict:
                 ("acceptance_rate", "acceptance_rate", 1),
                 ("draft_tokens", "draft_tokens", 1),
                 ("accepted_draft_tokens", "accepted_draft_tokens", 1),
+                # fleet serving (serve/fleet.py)
+                ("fleet_size", "fleet_size", 1),
+                ("shed", "shed", 1),
+                ("redispatched", "redispatched", 1),
+                ("engines_dead", "engines_dead", 1),
+                ("drains", "drains", 1),
+                ("leaked_blocks", "leaked_blocks", 1),
                 ("wall_s", "wall_s", 1)):
             val = summary.get(src)
             if isinstance(val, (int, float)):
                 view[dst] = round(val * scale, 4)
         view.setdefault("requests", summary.get("requests"))
         view.setdefault("output_tokens", summary.get("output_tokens"))
+        if summary.get("per_engine"):
+            view["per_engine"] = summary["per_engine"]
+    if counts:
+        # stream truncated before the fleet summary: the events still tell
+        # the robustness story
+        for dst, kind in (("shed", "serve_shed"),
+                          ("redispatched", "serve_redispatch"),
+                          ("engines_dead", "serve_engine_dead"),
+                          ("drains", "serve_drain")):
+            if dst not in view and counts.get(kind):
+                view[dst] = counts[kind]
     return view
 
 
@@ -441,6 +463,23 @@ def render(s: dict, markdown: bool = False) -> str:
                 f"  speculative: acceptance {pair('acceptance_rate')} "
                 f"({pair('accepted_draft_tokens')}/{pair('draft_tokens')} "
                 f"draft tokens accepted)")
+        if any(k in sv for k in ("fleet_size", "shed", "redispatched",
+                                 "engines_dead", "drains")):
+            lines.append(
+                f"  fleet: size {pair('fleet_size')} | shed {pair('shed')} "
+                f"| redispatched {pair('redispatched')} | engines dead "
+                f"{pair('engines_dead')} | drains {pair('drains')} | "
+                f"leaked blocks {pair('leaked_blocks')}")
+        for pe in sv.get("per_engine", []) or []:
+            state = ("drained" if pe.get("drained")
+                     else "alive" if pe.get("alive") else "dead")
+            lines.append(
+                f"    engine {pe.get('engine')}: {state}, "
+                f"{pe.get('requests')} requests, shed {pe.get('shed')}, "
+                f"{pe.get('decode_steps')} decode steps, preemptions "
+                f"{pe.get('preemptions')}, pool in_use "
+                f"{pe.get('pool_in_use')} (peak util "
+                f"{pe.get('pool_peak_utilization')})")
         lines.append("")
     rz = s.get("resize")
     if rz:
